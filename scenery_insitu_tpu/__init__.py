@@ -1,0 +1,33 @@
+"""scenery_insitu_tpu — a TPU-native in-situ distributed visualization framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+``Brockaaa/scenery-insitu`` (Kotlin/Vulkan/MPI/SysV-shm): in-situ volume
+rendering of distributed simulations via Volumetric Depth Images (VDIs),
+sort-last compositing over device meshes, particle rendering, simulation
+ingest, steering and streaming.
+
+Conventions (chosen once, used everywhere — the reference mixed NDC-z,
+world-length and integer-step depth encodings behind #defines and needed a
+converter pass to clean up; see /root/reference
+src/test/resources/.../VDIGenerator.comp:41-45 and ConvertToNDC.comp):
+
+- Volumes are scalar fields ``f32[D, H, W]`` indexed ``vol[z, y, x]`` with a
+  world-space ``origin`` and per-axis ``spacing`` (Volume dataclass).
+- Images are channels-first on device: ``f32[4, H, W]`` premultiplied RGBA,
+  converted to ``[H, W, 4]`` only at host/API boundaries. (H, W) occupy the
+  TPU (sublane, lane) tile dims.
+- VDIs store per-pixel supersegment lists with a *fixed* K
+  (``max_supersegments``) so every shape is static under jit:
+  ``color f32[K, 4, H, W]`` (premultiplied RGBA), ``depth f32[K, 2, H, W]``
+  (start/end). Unused slots have alpha == 0 and depth == (inf, inf).
+- Supersegment depths are the world-space ray parameter ``t`` of the *shared*
+  camera (all ranks render with the same camera, so t is comparable across
+  ranks per pixel and reconstructs world positions exactly:
+  ``w = origin + t * dir``).
+- Camera matrices follow the OpenGL convention (right-handed, camera looks
+  down -z, NDC z in [-1, 1]); helpers in core.camera.
+"""
+
+__version__ = "0.1.0"
+
+from scenery_insitu_tpu.config import FrameworkConfig  # noqa: F401

@@ -1,0 +1,208 @@
+"""Unified configuration system.
+
+The reference scattered configuration across three tiers — JVM system
+properties (``-DVolumeBenchmark.*``), fields poked in by C++ through JNI
+before init, and hardcoded Kotlin vals / shader ``#define`` feature flags
+(SURVEY.md §5 "Config / flag system"; reference DistributedVolumes.kt:88-131,
+VolumeFromFileExample.kt:69-82). Here everything lives in one tree of frozen
+dataclasses, overridable from environment variables, a JSON file, or
+``key.path=value`` strings, in that precedence order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+ENV_PREFIX = "SITPU_"
+
+
+@dataclass(frozen=True)
+class RenderConfig:
+    """Plain raycast / framebuffer settings (≅ VolumeRaycaster.comp knobs)."""
+
+    width: int = 1280
+    height: int = 720
+    max_steps: int = 512           # samples along each ray
+    step_scale: float = 1.0        # multiplies the nominal 1-voxel step
+    gamma: float = 2.2             # display gamma applied at host boundary
+    background: Tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
+    early_exit_alpha: float = 0.999  # ≅ AccumulatePlainImage.comp early exit
+
+
+@dataclass(frozen=True)
+class VDIConfig:
+    """Supersegment (VDI) generation settings (≅ VDIGenerator.comp knobs)."""
+
+    max_supersegments: int = 20     # K; reference default 20 (DistributedVolumes.kt:99)
+    # Fixed color-difference threshold for closing a supersegment. The
+    # reference adaptively binary-searches a per-pixel threshold so each ray
+    # emits between K*(1-delta) and K segments (VDIGenerator.comp:380-529);
+    # adaptive=True enables the same behavior via a bounded search.
+    threshold: float = 0.0
+    adaptive: bool = True
+    adaptive_iters: int = 6         # binary search iterations when adaptive
+    adaptive_delta: float = 0.15    # accept counts in [K*(1-delta), K]
+    # Occupancy grid (≅ OctreeCells r32ui [W/8, H/8, K]): cell size in pixels.
+    occupancy_cell: int = 8
+
+
+@dataclass(frozen=True)
+class CompositeConfig:
+    """Sort-last VDI compositing (≅ VDICompositor.comp)."""
+
+    max_output_supersegments: int = 20
+    # Re-segmentation threshold search on the composited ray (same meaning as
+    # VDIConfig.threshold/adaptive).
+    adaptive: bool = True
+    adaptive_iters: int = 6
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh / parallelism settings (replaces rank/commSize fields the
+    reference received from C++: DistributedVolumes.kt:103-117)."""
+
+    # Number of devices participating in sort-last compositing; 0 = all.
+    num_devices: int = 0
+    axis_name: str = "ranks"
+    # 3D domain-decomposition grid (dz, dy, dx); (0,0,0) = auto 1D over z.
+    decomposition: Tuple[int, int, int] = (0, 0, 0)
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Built-in simulation settings (standalone mode; the reference could not
+    run standalone — README.md:16 — this framework can)."""
+
+    kind: str = "gray_scott"        # gray_scott | vortex | lennard_jones | sho
+    grid: Tuple[int, int, int] = (128, 128, 128)
+    steps_per_frame: int = 10
+    dt: float = 1.0
+    # Gray-Scott parameters (classic "solitons" regime)
+    gs_f: float = 0.0545
+    gs_k: float = 0.062
+    gs_du: float = 0.16
+    gs_dv: float = 0.08
+    num_particles: int = 100_000
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Session-loop, dump and benchmark flags (≅ the hardcoded vals
+    generateVDIs/saveFinal/benchmarking, DistributedVolumes.kt:88-92)."""
+
+    generate_vdis: bool = True
+    save_final: bool = False
+    dump_dir: str = "dumps"
+    benchmark: bool = False
+    benchmark_frames: int = 100
+    stats_window: int = 100         # frames between timer-stat dumps
+    dataset: str = "procedural"
+
+
+@dataclass(frozen=True)
+class StreamConfig:
+    """Steering / streaming endpoints (≅ ZMQ :6655 + UDP :3337,
+    VolumeFromFileExample.kt:840-854; DistributedVolumeRenderer.kt:278-283)."""
+
+    steer_bind: str = "tcp://*:6655"
+    steer_connect: str = "tcp://localhost:6655"
+    video_port: int = 3337
+    compress: str = "lz4"           # lz4 | zlib | none
+
+
+@dataclass(frozen=True)
+class FrameworkConfig:
+    render: RenderConfig = field(default_factory=RenderConfig)
+    vdi: VDIConfig = field(default_factory=VDIConfig)
+    composite: CompositeConfig = field(default_factory=CompositeConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    sim: SimConfig = field(default_factory=SimConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    stream: StreamConfig = field(default_factory=StreamConfig)
+
+    # ------------------------------------------------------------------ IO
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FrameworkConfig":
+        return _merge_into(cls(), d)
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "FrameworkConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def with_overrides(self, *assignments: str, **env: Optional[dict]) -> "FrameworkConfig":
+        """Apply ``section.key=value`` strings, e.g. ``render.width=512``."""
+        cfg = self
+        for a in assignments:
+            key, _, raw = a.partition("=")
+            if not _:
+                raise ValueError(f"override must look like section.key=value: {a!r}")
+            cfg = _assign(cfg, key.strip().split("."), _parse_value(raw.strip()))
+        return cfg
+
+    @classmethod
+    def load(cls, path: Optional[str] = None, overrides: Tuple[str, ...] = ()) -> "FrameworkConfig":
+        """File < env (SITPU_SECTION_KEY=value) < explicit overrides."""
+        cfg = cls.from_json_file(path) if path else cls()
+        for name, raw in os.environ.items():
+            if not name.startswith(ENV_PREFIX):
+                continue
+            parts = name[len(ENV_PREFIX):].lower().split("_", 1)
+            if len(parts) == 2 and hasattr(cfg, parts[0]):
+                try:
+                    cfg = _assign(cfg, parts, _parse_value(raw))
+                except (ValueError, AttributeError):
+                    pass
+        return cfg.with_overrides(*overrides)
+
+
+def _parse_value(raw: str) -> Any:
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return raw
+
+
+def _assign(cfg: Any, path: list, value: Any) -> Any:
+    head = path[0]
+    if not hasattr(cfg, head):
+        raise AttributeError(f"no config field {head!r} on {type(cfg).__name__}")
+    if len(path) == 1:
+        current = getattr(cfg, head)
+        if current is not None and not isinstance(value, type(current)):
+            if isinstance(current, tuple):
+                value = tuple(value)
+            elif isinstance(current, float) and isinstance(value, int):
+                value = float(value)
+            elif isinstance(current, bool) and isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes", "on")
+            elif isinstance(current, (int, float)) and isinstance(value, str):
+                value = type(current)(value)
+        return dataclasses.replace(cfg, **{head: value})
+    return dataclasses.replace(cfg, **{head: _assign(getattr(cfg, head), path[1:], value)})
+
+
+def _merge_into(cfg: Any, d: dict) -> Any:
+    updates = {}
+    for k, v in d.items():
+        if not hasattr(cfg, k):
+            raise AttributeError(f"no config field {k!r} on {type(cfg).__name__}")
+        current = getattr(cfg, k)
+        if dataclasses.is_dataclass(current) and isinstance(v, dict):
+            updates[k] = _merge_into(current, v)
+        elif isinstance(current, tuple) and isinstance(v, list):
+            updates[k] = tuple(v)
+        else:
+            updates[k] = v
+    return dataclasses.replace(cfg, **updates)

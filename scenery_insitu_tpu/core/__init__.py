@@ -1,0 +1,4 @@
+from scenery_insitu_tpu.core.camera import Camera  # noqa: F401
+from scenery_insitu_tpu.core.volume import Volume  # noqa: F401
+from scenery_insitu_tpu.core.transfer import TransferFunction  # noqa: F401
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata  # noqa: F401

@@ -1,0 +1,114 @@
+"""Transfer functions and colormaps.
+
+The reference builds per-dataset piecewise-linear opacity ramps and colormaps
+(scenery ``TransferFunction.ramp`` + ``Colormap``; reference
+DistributedVolumes.kt:179-219, VolumeFromFileExample.kt:405-455). Here a
+transfer function is a pair of lookup tables sampled with linear
+interpolation — a dense [N] opacity LUT and an [N, 3] color LUT — built from
+control points, fully differentiable and jit-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+LUT_SIZE = 256
+
+
+class TransferFunction(NamedTuple):
+    """Maps normalized scalar value [0,1] -> (rgb, alpha)."""
+
+    color_lut: jnp.ndarray   # f32[N, 3]
+    alpha_lut: jnp.ndarray   # f32[N]
+
+    @classmethod
+    def ramp(cls, low: float = 0.0, high: float = 1.0, max_alpha: float = 1.0,
+             colormap: str = "grays") -> "TransferFunction":
+        """Opacity 0 below `low`, linear to `max_alpha` at `high`
+        (≅ scenery TransferFunction.ramp used at DistributedVolumes.kt:183)."""
+        x = np.linspace(0.0, 1.0, LUT_SIZE, dtype=np.float32)
+        a = np.clip((x - low) / max(high - low, 1e-6), 0.0, 1.0) * max_alpha
+        return cls(jnp.asarray(colormap_lut(colormap)), jnp.asarray(a))
+
+    @classmethod
+    def points(cls, pts: Sequence[Tuple[float, float]],
+               colormap: str = "grays") -> "TransferFunction":
+        """Piecewise-linear opacity through (value, alpha) control points
+        (≅ the addControlPoint chains, DistributedVolumes.kt:187-217)."""
+        pts = sorted(pts)
+        xs = np.array([p[0] for p in pts], np.float32)
+        ys = np.array([p[1] for p in pts], np.float32)
+        x = np.linspace(0.0, 1.0, LUT_SIZE, dtype=np.float32)
+        a = np.interp(x, xs, ys).astype(np.float32)
+        return cls(jnp.asarray(colormap_lut(colormap)), jnp.asarray(a))
+
+    def __call__(self, value: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Sample -> (rgb f32[..., 3], alpha f32[...]). Linear interp."""
+        n = self.alpha_lut.shape[0]
+        x = jnp.clip(value, 0.0, 1.0) * (n - 1)
+        i0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, n - 2)
+        frac = x - i0
+        a = self.alpha_lut[i0] * (1 - frac) + self.alpha_lut[i0 + 1] * frac
+        rgb = (self.color_lut[i0] * (1 - frac)[..., None]
+               + self.color_lut[i0 + 1] * frac[..., None])
+        return rgb, a
+
+
+def colormap_lut(name: str, n: int = LUT_SIZE) -> np.ndarray:
+    """Built-in colormaps as f32[n, 3] (≅ scenery Colormap.get, used with
+    "hot"/"jet"/"grays" at VolumeFromFileExample.kt:399-403)."""
+    x = np.linspace(0.0, 1.0, n, dtype=np.float32)
+    if name == "grays":
+        rgb = np.stack([x, x, x], -1)
+    elif name == "hot":
+        r = np.clip(3 * x, 0, 1)
+        g = np.clip(3 * x - 1, 0, 1)
+        b = np.clip(3 * x - 2, 0, 1)
+        rgb = np.stack([r, g, b], -1)
+    elif name == "jet":
+        r = np.clip(1.5 - np.abs(4 * x - 3), 0, 1)
+        g = np.clip(1.5 - np.abs(4 * x - 2), 0, 1)
+        b = np.clip(1.5 - np.abs(4 * x - 1), 0, 1)
+        rgb = np.stack([r, g, b], -1)
+    elif name == "viridis":
+        # 8-anchor approximation of matplotlib viridis
+        anchors = np.array([
+            [0.267, 0.005, 0.329], [0.283, 0.141, 0.458],
+            [0.254, 0.265, 0.530], [0.207, 0.372, 0.553],
+            [0.164, 0.471, 0.558], [0.128, 0.567, 0.551],
+            [0.135, 0.659, 0.518], [0.267, 0.749, 0.441],
+            [0.478, 0.821, 0.318], [0.741, 0.873, 0.150],
+            [0.993, 0.906, 0.144]], np.float32)
+        ax = np.linspace(0, 1, len(anchors))
+        rgb = np.stack([np.interp(x, ax, anchors[:, c]) for c in range(3)], -1)
+    else:
+        raise ValueError(f"unknown colormap {name!r}")
+    return rgb.astype(np.float32)
+
+
+# Per-dataset transfer functions mirroring the reference's hand-tuned tables
+# (VolumeFromFileExample.kt:405-455, DistributedVolumes.kt:179-219).
+DATASET_TRANSFER_FUNCTIONS = {
+    "kingsnake": lambda: TransferFunction.points(
+        [(0.0, 0.0), (0.43, 0.0), (0.5, 0.005)], "grays"),
+    "beechnut": lambda: TransferFunction.points(
+        [(0.0, 0.0), (0.43, 0.0), (0.457, 0.321), (0.494, 0.0), (1.0, 0.0)], "grays"),
+    "simulation": lambda: TransferFunction.points(
+        [(0.0, 0.0), (0.1, 0.0), (0.15, 0.1), (0.22, 0.05), (1.0, 0.1)], "hot"),
+    "rayleigh_taylor": lambda: TransferFunction.points(
+        [(0.0, 0.3), (0.3, 0.05), (0.5, 0.0), (0.7, 0.05), (1.0, 0.3)], "jet"),
+    "rotstrat": lambda: TransferFunction.ramp(0.0, 1.0, 0.4, "jet"),
+    "procedural": lambda: TransferFunction.ramp(0.05, 0.8, 0.5, "hot"),
+    "gray_scott": lambda: TransferFunction.points(
+        [(0.0, 0.0), (0.12, 0.0), (0.3, 0.12), (0.65, 0.3), (1.0, 0.5)], "viridis"),
+}
+
+
+def for_dataset(name: str) -> TransferFunction:
+    try:
+        return DATASET_TRANSFER_FUNCTIONS[name.lower()]()
+    except KeyError:
+        return TransferFunction.ramp(0.05, 0.8, 0.5, "grays")

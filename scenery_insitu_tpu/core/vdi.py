@@ -1,0 +1,104 @@
+"""Volumetric Depth Image (VDI) data model.
+
+A VDI stores, per pixel, an ordered list of at most K "supersegments": depth-
+bounded slabs of premultiplied RGBA that summarize the volume along that
+pixel's ray. This mirrors the reference's OutputSubVDIColor rgba32f
+``[K, H, W]`` + OutputSubVDIDepth r32f ``[2K, H, W]`` textures (reference
+DistributedVolumes.kt:331-368) with one layout decision made for TPU: (H, W)
+are always the trailing (sublane, lane) dims and K/channel axes lead.
+
+Empty-slot convention (static K keeps every shape jit-compatible):
+``alpha == 0`` and ``depth == +inf`` for unused slots; live slots are sorted
+front-to-back and non-overlapping per pixel.
+
+Depths are the world-space ray parameter t of the generating camera — see the
+package docstring for why (one depth encoding instead of the reference's
+three).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class VDIMetadata(NamedTuple):
+    """Everything needed to interpret / re-render a VDI
+    (≅ scenery VDIData: projection, view, volumeDims, model, nw, windowDims —
+    reference DistributedVolumes.kt:706-716)."""
+
+    projection: jnp.ndarray    # f32[4, 4]
+    view: jnp.ndarray          # f32[4, 4]
+    model: jnp.ndarray         # f32[4, 4] volume model matrix (origin/spacing)
+    volume_dims: jnp.ndarray   # f32[3] (x, y, z) voxel counts
+    window_dims: jnp.ndarray   # i32[2] (width, height)
+    nw: jnp.ndarray            # f32[] world-space step size ("nw" in reference)
+    index: jnp.ndarray         # i32[] frame index
+
+    @classmethod
+    def create(cls, projection, view, model=None, volume_dims=(0, 0, 0),
+               window_dims=(0, 0), nw: float = 0.0, index: int = 0) -> "VDIMetadata":
+        model = jnp.eye(4, dtype=jnp.float32) if model is None else jnp.asarray(model, jnp.float32)
+        return cls(jnp.asarray(projection, jnp.float32),
+                   jnp.asarray(view, jnp.float32), model,
+                   jnp.asarray(volume_dims, jnp.float32),
+                   jnp.asarray(window_dims, jnp.int32),
+                   jnp.asarray(nw, jnp.float32),
+                   jnp.asarray(index, jnp.int32))
+
+
+class VDI(NamedTuple):
+    color: jnp.ndarray   # f32[K, 4, H, W] premultiplied RGBA per supersegment
+    depth: jnp.ndarray   # f32[K, 2, H, W] (t_start, t_end); +inf when empty
+
+    @property
+    def k(self) -> int:
+        return self.color.shape[0]
+
+    @property
+    def height(self) -> int:
+        return self.color.shape[2]
+
+    @property
+    def width(self) -> int:
+        return self.color.shape[3]
+
+    @property
+    def count(self) -> jnp.ndarray:
+        """i32[H, W] number of live supersegments per pixel."""
+        return jnp.sum(self.color[:, 3] > 0.0, axis=0).astype(jnp.int32)
+
+    @classmethod
+    def empty(cls, k: int, height: int, width: int) -> "VDI":
+        return cls(jnp.zeros((k, 4, height, width), jnp.float32),
+                   jnp.full((k, 2, height, width), jnp.inf, jnp.float32))
+
+
+def render_vdi_same_view(vdi: VDI, background: Tuple[float, ...] = (0, 0, 0, 0)
+                         ) -> jnp.ndarray:
+    """Alpha-under all supersegments front-to-back from the generating
+    camera's own view — the cheapest full decode of a VDI, used for parity
+    tests (≅ SimpleVDIRenderer.comp:43-74). Returns f32[4, H, W]."""
+    import jax
+
+    order = jnp.argsort(vdi.depth[:, 0], axis=0)                    # [K, H, W]
+    color = jnp.take_along_axis(vdi.color, order[:, None], axis=0)  # [K,4,H,W]
+
+    def body(acc, src):
+        return acc + (1.0 - acc[3:4]) * src, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros_like(color[0]), color)
+    bg = jnp.asarray(background, jnp.float32).reshape(4, 1, 1)
+    return acc + (1.0 - acc[3:4]) * bg
+
+
+def vdi_nbytes(k: int, height: int, width: int) -> int:
+    """Uncompressed payload size (color + depth) in bytes; the reference's
+    per-rank per-frame wire size (SURVEY.md §6: ~442 MB at 1280x720, K=20)."""
+    return k * height * width * (4 + 2) * 4
+
+
+def to_numpy(vdi: VDI) -> Tuple[np.ndarray, np.ndarray]:
+    return np.asarray(vdi.color), np.asarray(vdi.depth)

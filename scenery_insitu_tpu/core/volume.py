@@ -1,0 +1,115 @@
+"""Volume data model.
+
+A Volume is a scalar field ``f32[D, H, W]`` (indexed ``[z, y, x]``) with a
+world-space placement: ``origin`` (world position of the grid's min corner)
+and per-axis ``spacing`` (world size of one voxel). This replaces the
+reference's scenery ``Volume.fromBuffer`` nodes positioned at per-grid origins
+(reference DistributedVolumes.kt:147-240; DistributedVolumeRenderer.kt:326-394)
+and its raw-file loader ``fromPathRaw`` (VolumeFromFileExample.kt:159-217).
+
+Values are kept normalized to [0, 1]; loaders divide by the dtype range
+(uint8/uint16 raw files, is16bit flag ≅ DistributedVolumes.kt:147).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Volume(NamedTuple):
+    data: jnp.ndarray      # f32[D, H, W] normalized scalar field, vol[z, y, x]
+    origin: jnp.ndarray    # f32[3] world position of min corner (x, y, z)
+    spacing: jnp.ndarray   # f32[3] world size of a voxel (x, y, z)
+
+    @classmethod
+    def create(cls, data, origin=(0.0, 0.0, 0.0), spacing=(1.0, 1.0, 1.0)) -> "Volume":
+        return cls(jnp.asarray(data, jnp.float32),
+                   jnp.asarray(origin, jnp.float32),
+                   jnp.asarray(spacing, jnp.float32))
+
+    @classmethod
+    def centered(cls, data, extent: float = 2.0) -> "Volume":
+        """Place the volume centered at the world origin with its largest side
+        spanning `extent` world units."""
+        data = jnp.asarray(data, jnp.float32)
+        d, h, w = data.shape
+        vox = extent / max(d, h, w)
+        size = jnp.array([w * vox, h * vox, d * vox], jnp.float32)
+        return cls(data, -size / 2.0, jnp.full((3,), vox, jnp.float32))
+
+    @property
+    def dims_xyz(self) -> Tuple[int, int, int]:
+        d, h, w = self.data.shape
+        return (w, h, d)
+
+    @property
+    def world_min(self) -> jnp.ndarray:
+        return self.origin
+
+    @property
+    def world_max(self) -> jnp.ndarray:
+        d, h, w = self.data.shape
+        return self.origin + jnp.array([w, h, d], jnp.float32) * self.spacing
+
+    def world_to_voxel(self, p: jnp.ndarray) -> jnp.ndarray:
+        """World position [..., 3] (x,y,z) -> continuous voxel coords [..., 3]
+        (x,y,z), where voxel centers sit at integer+0.5."""
+        return (p - self.origin) / self.spacing
+
+
+def load_raw(path: str, dims_xyz: Tuple[int, int, int],
+             is16bit: bool = False, extent: float = 2.0) -> Volume:
+    """Load a raw binary volume file (x-fastest layout, as the reference's
+    dataset table expects: VolumeFromFileExample.kt:104-120, 159-217)."""
+    w, h, d = dims_xyz
+    dtype = np.uint16 if is16bit else np.uint8
+    raw = np.fromfile(path, dtype=dtype, count=w * h * d).reshape(d, h, w)
+    data = raw.astype(np.float32) / float(np.iinfo(dtype).max)
+    return Volume.centered(jnp.asarray(data), extent)
+
+
+# Dataset dimension table mirroring VolumeFromFileExample.kt:104-120 so raw
+# files drop in by name.
+DATASET_DIMS_XYZ = {
+    "kingsnake": (1024, 1024, 795),
+    "beechnut": (1024, 1024, 1546),
+    "simulation": (2048, 2048, 1920),
+    "rayleigh_taylor": (1024, 1024, 1024),
+    "microscopy": (1024, 1024, 1040),
+    "rotstrat": (4096, 4096, 4096),
+}
+
+
+def load_dataset(name: str, data_dir: str, extent: float = 2.0) -> Volume:
+    dims = DATASET_DIMS_XYZ[name.lower()]
+    path = os.path.join(data_dir, f"{name}.raw")
+    return load_raw(path, dims, is16bit=True, extent=extent)
+
+
+def procedural_volume(size: int = 128, seed: int = 0,
+                      kind: str = "blobs") -> Volume:
+    """Procedural test volume (≅ Volume.generateProceduralVolume used as the
+    fake-simulation fixture, reference VDIGenerationExample.kt:182-213)."""
+    rng = np.random.default_rng(seed)
+    z, y, x = np.meshgrid(*(np.linspace(-1, 1, size, dtype=np.float32),) * 3,
+                          indexing="ij")
+    if kind == "blobs":
+        field = np.zeros_like(x)
+        for _ in range(6):
+            c = rng.uniform(-0.6, 0.6, 3).astype(np.float32)
+            r = rng.uniform(0.15, 0.4)
+            field += np.exp(-(((x - c[0]) ** 2 + (y - c[1]) ** 2
+                               + (z - c[2]) ** 2) / (r * r)))
+        field /= field.max()
+    elif kind == "shell":
+        r = np.sqrt(x * x + y * y + z * z)
+        field = np.exp(-((r - 0.6) ** 2) / 0.01).astype(np.float32)
+    elif kind == "gradient":
+        field = (x + 1) / 2
+    else:
+        raise ValueError(f"unknown procedural volume kind {kind!r}")
+    return Volume.centered(jnp.asarray(field.astype(np.float32)))

@@ -1,0 +1,1 @@
+from scenery_insitu_tpu.ops.raycast import raycast  # noqa: F401

@@ -1,0 +1,79 @@
+"""Plain front-to-back volume raycaster — the minimum end-to-end slice
+(SURVEY.md §7 step 2; ≅ reference VolumeRaycaster.comp:94-161 +
+AccumulatePlainImage.comp + ComputeRaycast.comp).
+
+Pure-JAX implementation: the march is a ``lax.fori_loop`` with a static trip
+count over ``[H, W]``-shaped vectorized steps, so XLA sees one fused
+elementwise+gather body — no per-pixel Python control flow, no dynamic
+shapes. (A Pallas kernel with identical semantics lives in
+``ops/pallas/``; tests assert parity.)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.config import RenderConfig
+from scenery_insitu_tpu.core.camera import Camera, pixel_rays
+from scenery_insitu_tpu.core.transfer import TransferFunction
+from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.ops.sampling import (adjust_opacity, intersect_aabb,
+                                             sample_volume_world)
+
+
+class RaycastOutput(NamedTuple):
+    image: jnp.ndarray    # f32[4, H, W] premultiplied RGBA
+    depth: jnp.ndarray    # f32[H, W] ray parameter t of first hit (alpha>eps);
+                          # +inf where the ray saw nothing (≅ the RGBA-encoded
+                          # start-depth image, VolumeRaycaster.comp:128-141)
+
+
+def nominal_step(vol: Volume, scale: float = 1.0) -> jnp.ndarray:
+    """World-space nominal sampling distance: one (min-axis) voxel * scale.
+    This is the "nw" the reference carries in VDIData."""
+    return jnp.min(vol.spacing) * scale
+
+
+def raycast(vol: Volume, tf: TransferFunction, cam: Camera,
+            width: int, height: int, cfg: Optional[RenderConfig] = None,
+            ) -> RaycastOutput:
+    cfg = cfg or RenderConfig(width=width, height=height)
+    origin, dirs = pixel_rays(cam, width, height)          # [3], [3, H, W]
+    tnear, tfar = intersect_aabb(origin, dirs, vol.world_min, vol.world_max)
+    hit = tfar > tnear                                     # [H, W]
+    tfar = jnp.maximum(tfar, tnear)
+
+    n = cfg.max_steps
+    dt = (tfar - tnear) / n                                # [H, W] per-pixel
+    nw = nominal_step(vol, cfg.step_scale)
+
+    def body(i, carry):
+        acc, first_t = carry
+        t = tnear + (i + 0.5) * dt                         # [H, W]
+        pos = origin.reshape(3, 1, 1) + t[None] * dirs     # [3, H, W]
+        val = sample_volume_world(vol, jnp.moveaxis(pos, 0, -1))
+        rgb, a = tf(val)                                   # [H,W,3], [H,W]
+        a = adjust_opacity(a, dt / nw)
+        a = jnp.where(hit & (acc[3] < cfg.early_exit_alpha), a, 0.0)
+        src = jnp.concatenate([jnp.moveaxis(rgb, -1, 0) * a[None], a[None]])
+        acc = acc + (1.0 - acc[3:4]) * src
+        first_t = jnp.where((first_t == jnp.inf) & (a > 1e-4), t, first_t)
+        return acc, first_t
+
+    acc0 = jnp.zeros((4, height, width), jnp.float32)
+    t0 = jnp.full((height, width), jnp.inf, jnp.float32)
+    acc, first_t = jax.lax.fori_loop(0, n, body, (acc0, t0))
+
+    bg = jnp.asarray(cfg.background, jnp.float32).reshape(4, 1, 1)
+    image = acc + (1.0 - acc[3:4]) * bg
+    return RaycastOutput(image, first_t)
+
+
+def raycast_image(vol: Volume, tf: TransferFunction, cam: Camera,
+                  width: int, height: int,
+                  cfg: Optional[RenderConfig] = None) -> jnp.ndarray:
+    """Convenience wrapper returning just the image f32[4, H, W]."""
+    return raycast(vol, tf, cam, width, height, cfg).image

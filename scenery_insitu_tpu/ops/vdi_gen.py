@@ -1,0 +1,102 @@
+"""VDI generation: raycast a volume into per-pixel supersegment lists
+(SURVEY.md §7 step 3; ≅ reference VDIGenerator.comp + AccumulateVDI.comp).
+
+The march is a static-trip ``lax.fori_loop`` feeding the vectorized
+supersegment state machine (ops.supersegments). Adaptive per-pixel
+thresholding runs ``adaptive_iters`` cheap counting marches first — see the
+supersegments module docstring for why this replaces the reference's
+in-kernel binary search.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.config import VDIConfig
+from scenery_insitu_tpu.core.camera import (Camera, pixel_rays,
+                                            projection_matrix, view_matrix)
+from scenery_insitu_tpu.core.transfer import TransferFunction
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
+from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.ops import supersegments as ss
+from scenery_insitu_tpu.ops.raycast import nominal_step
+from scenery_insitu_tpu.ops.sampling import (adjust_opacity, intersect_aabb,
+                                             sample_volume_world)
+
+
+def generate_vdi(vol: Volume, tf: TransferFunction, cam: Camera,
+                 width: int, height: int,
+                 cfg: Optional[VDIConfig] = None,
+                 max_steps: int = 512,
+                 frame_index: int = 0) -> Tuple[VDI, VDIMetadata]:
+    cfg = cfg or VDIConfig()
+    k = cfg.max_supersegments
+    origin, dirs = pixel_rays(cam, width, height)
+    tnear, tfar = intersect_aabb(origin, dirs, vol.world_min, vol.world_max)
+    hit = tfar > tnear
+    tfar = jnp.maximum(tfar, tnear)
+    n = max_steps
+    dt = (tfar - tnear) / n                                   # [H, W]
+    nw = nominal_step(vol)
+
+    def sample_at(i):
+        """Premultiplied RGBA of march step i -> [4, H, W] plus (t0, t1)."""
+        t = tnear + (i + 0.5) * dt
+        pos = origin.reshape(3, 1, 1) + t[None] * dirs
+        val = sample_volume_world(vol, jnp.moveaxis(pos, 0, -1))
+        rgb, a = tf(val)
+        a = jnp.where(hit, adjust_opacity(a, dt / nw), 0.0)
+        rgba = jnp.concatenate([jnp.moveaxis(rgb, -1, 0) * a[None], a[None]])
+        return rgba, t - 0.5 * dt, t + 0.5 * dt
+
+    if cfg.adaptive:
+        def count_fn(thr):
+            def body(i, st):
+                rgba, _, _ = sample_at(i)
+                return ss.push_count(st, thr, rgba)
+            return jax.lax.fori_loop(0, n, body,
+                                     ss.init_count(height, width)).count
+        threshold = ss.adaptive_threshold(count_fn, k, cfg.adaptive_iters,
+                                          height, width)
+    else:
+        threshold = jnp.full((height, width), cfg.threshold, jnp.float32)
+
+    def body(i, st):
+        rgba, t0, t1 = sample_at(i)
+        return ss.push(st, k, threshold, rgba, t0, t1)
+
+    state = jax.lax.fori_loop(0, n, body, ss.init_state(k, height, width))
+    color, depth = ss.finalize(state)
+
+    meta = VDIMetadata.create(
+        projection=projection_matrix(cam, width, height),
+        view=view_matrix(cam),
+        volume_dims=jnp.asarray(vol.dims_xyz, jnp.float32),
+        window_dims=(width, height), nw=nw, index=frame_index)
+    return VDI(color, depth), meta
+
+
+def occupancy_grid(vdi: VDI, tnear: jnp.ndarray, tfar: jnp.ndarray,
+                   cell: int = 8, depth_bins: Optional[int] = None) -> jnp.ndarray:
+    """Screen-space occupancy acceleration structure
+    (≅ OctreeCells r32ui [W/8, H/8, K] filled by imageAtomicAdd,
+    VDIGenerator.comp:232-254 — here a post-pass count over the finished VDI
+    instead of in-march atomics). Returns i32[B, H//cell, W//cell]: number of
+    supersegments overlapping each depth bin in each pixel cell; depth bins
+    span [min tnear, max tfar] linearly."""
+    b = depth_bins or vdi.k
+    lo = jnp.min(tnear)
+    hi = jnp.maximum(jnp.max(jnp.where(jnp.isfinite(tfar), tfar, lo)), lo + 1e-6)
+    edges = jnp.linspace(lo, hi, b + 1)
+    start, end = vdi.depth[:, 0], vdi.depth[:, 1]          # [K, H, W]
+    live = vdi.color[:, 3] > 0.0
+    overlap = (start[None] < edges[1:, None, None, None]) & \
+              (end[None] > edges[:-1, None, None, None]) & live[None]  # [B,K,H,W]
+    per_pixel = jnp.sum(overlap, axis=1)                   # [B, H, W]
+    hh = (per_pixel.shape[1] // cell) * cell
+    ww = (per_pixel.shape[2] // cell) * cell
+    pooled = per_pixel[:, :hh, :ww].reshape(b, hh // cell, cell, ww // cell, cell)
+    return pooled.sum(axis=(2, 4)).astype(jnp.int32)

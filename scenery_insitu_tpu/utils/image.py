@@ -1,0 +1,35 @@
+"""Host-boundary image utilities (gamma, PNG, diff metrics).
+
+Gamma is applied exactly once, here, at the host boundary (the reference
+applied ``pow(v, 1/2.2)`` inside the generation shader,
+VDIGenerator.comp:537 — one of the parity hazards SURVEY.md §7 flags)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def to_display(image_chw: np.ndarray, gamma: float = 2.2,
+               unpremultiply: bool = False) -> np.ndarray:
+    """f32[4, H, W] premultiplied linear RGBA -> uint8[H, W, 4] display."""
+    img = np.asarray(image_chw, np.float32)
+    rgb, a = img[:3], img[3:4]
+    if unpremultiply:
+        rgb = rgb / np.maximum(a, 1e-6)
+    rgb = np.clip(rgb, 0.0, 1.0) ** (1.0 / gamma)
+    out = np.concatenate([rgb, np.clip(a, 0.0, 1.0)], axis=0)
+    return (np.moveaxis(out, 0, -1) * 255.0 + 0.5).astype(np.uint8)
+
+
+def save_png(path: str, image_chw: np.ndarray, gamma: float = 2.2) -> None:
+    from PIL import Image
+    Image.fromarray(to_display(np.asarray(image_chw), gamma)).save(path)
+
+
+def psnr(a: np.ndarray, b: np.ndarray, peak: float = 1.0) -> float:
+    mse = float(np.mean((np.asarray(a, np.float64) - np.asarray(b, np.float64)) ** 2))
+    if mse == 0:
+        return float("inf")
+    return 10.0 * np.log10(peak * peak / mse)

@@ -1,0 +1,29 @@
+"""Test harness: force a virtual 8-device CPU mesh so multi-chip sharding
+logic is exercised without TPU hardware (SURVEY.md §4 lesson — single-host
+stand-ins for the cluster).
+
+The environment may register an external TPU plugin ("axon") at interpreter
+start and pin JAX_PLATFORMS to it; tests must never touch that backend (it
+tunnels to one shared real chip), so we hard-override the platform AND drop
+the plugin's backend factory before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+assert jax.default_backend() == "cpu"
+assert jax.device_count() == 8, jax.devices()

@@ -1,0 +1,71 @@
+import jax.numpy as jnp
+import numpy as np
+
+from scenery_insitu_tpu.core.camera import (Camera, look_at, orbit,
+                                            perspective, pixel_rays,
+                                            projection_matrix, view_matrix,
+                                            world_to_ndc)
+
+
+def _cam(eye=(0.0, 0.0, 3.0)):
+    return Camera.create(eye, target=(0, 0, 0), fov_y_deg=60.0, near=0.5, far=10.0)
+
+
+def test_look_at_maps_eye_to_origin():
+    cam = _cam()
+    v = view_matrix(cam)
+    e = jnp.concatenate([cam.eye, jnp.ones(1)])
+    assert np.allclose(v @ e, [0, 0, 0, 1], atol=1e-6)
+
+
+def test_look_at_target_on_negative_z():
+    cam = _cam(eye=(1.0, 2.0, 3.0))
+    v = view_matrix(cam)
+    t = np.asarray(v @ jnp.concatenate([cam.target, jnp.ones(1)]))
+    assert abs(t[0]) < 1e-5 and abs(t[1]) < 1e-5 and t[2] < 0
+
+
+def test_perspective_near_far_ndc():
+    p = perspective(jnp.deg2rad(60.0), 1.0, 0.5, 10.0)
+    for z_eye, z_ndc in [(-0.5, -1.0), (-10.0, 1.0)]:
+        clip = np.asarray(p @ jnp.array([0.0, 0.0, z_eye, 1.0]))
+        assert np.isclose(clip[2] / clip[3], z_ndc, atol=1e-5)
+
+
+def test_center_ray_points_at_target():
+    cam = _cam(eye=(1.0, 1.0, 4.0))
+    origin, dirs = pixel_rays(cam, 64, 64)
+    center = np.asarray(dirs[:, 32, 32])
+    expected = np.array(cam.target - cam.eye)
+    expected = expected / np.linalg.norm(expected)
+    # pixel center is half a pixel off the optical axis
+    assert np.dot(center, expected) > 0.999
+
+
+def test_rays_unit_length():
+    origin, dirs = pixel_rays(_cam(), 16, 8)
+    norms = np.linalg.norm(np.asarray(dirs), axis=0)
+    assert np.allclose(norms, 1.0, atol=1e-5)
+
+
+def test_world_to_ndc_roundtrip_with_rays():
+    cam = _cam(eye=(0.5, -0.3, 3.0))
+    w, h = 32, 24
+    origin, dirs = pixel_rays(cam, w, h)
+    t = 2.0
+    pts = np.asarray(origin)[:, None, None] + t * np.asarray(dirs)  # [3,H,W]
+    ndc = world_to_ndc(jnp.moveaxis(jnp.asarray(pts), 0, -1),
+                       view_matrix(cam), projection_matrix(cam, w, h))
+    # pixel (i, j) center should project back to its own NDC coordinate
+    j, i = 7, 5
+    exp_x = (j + 0.5) / w * 2 - 1
+    exp_y = 1 - (i + 0.5) / h * 2
+    assert np.allclose(np.asarray(ndc)[i, j, :2], [exp_x, exp_y], atol=1e-4)
+
+
+def test_orbit_preserves_distance():
+    cam = _cam(eye=(0.0, 1.0, 3.0))
+    cam2 = orbit(cam, jnp.pi / 3, 0.2)
+    d1 = np.linalg.norm(np.asarray(cam.eye - cam.target))
+    d2 = np.linalg.norm(np.asarray(cam2.eye - cam2.target))
+    assert np.isclose(d1, d2, atol=1e-5)

@@ -1,0 +1,38 @@
+import json
+
+from scenery_insitu_tpu.config import FrameworkConfig
+
+
+def test_defaults():
+    cfg = FrameworkConfig()
+    assert cfg.vdi.max_supersegments == 20
+    assert cfg.render.width == 1280
+
+
+def test_overrides():
+    cfg = FrameworkConfig().with_overrides(
+        "render.width=512", "vdi.max_supersegments=12", "runtime.benchmark=true")
+    assert cfg.render.width == 512
+    assert cfg.vdi.max_supersegments == 12
+    assert cfg.runtime.benchmark is True
+
+
+def test_json_roundtrip(tmp_path):
+    cfg = FrameworkConfig().with_overrides("sim.grid=[64,64,64]", "render.gamma=1.0")
+    p = tmp_path / "cfg.json"
+    p.write_text(cfg.to_json())
+    cfg2 = FrameworkConfig.from_json_file(str(p))
+    assert cfg2 == cfg
+    assert cfg2.sim.grid == (64, 64, 64)
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("SITPU_RENDER_WIDTH", "320")
+    cfg = FrameworkConfig.load()
+    assert cfg.render.width == 320
+
+
+def test_unknown_key_rejected():
+    import pytest
+    with pytest.raises(AttributeError):
+        FrameworkConfig.from_dict({"nope": 1})

@@ -1,0 +1,63 @@
+import jax.numpy as jnp
+import numpy as np
+
+from scenery_insitu_tpu.core.volume import Volume, procedural_volume
+from scenery_insitu_tpu.ops.sampling import (adjust_opacity, intersect_aabb,
+                                             sample_trilinear,
+                                             sample_volume_world)
+
+
+def test_trilinear_at_voxel_centers():
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.random((4, 5, 6), dtype=np.float32))
+    zz, yy, xx = np.meshgrid(range(4), range(5), range(6), indexing="ij")
+    pos = jnp.asarray(np.stack([xx + 0.5, yy + 0.5, zz + 0.5], -1), jnp.float32)
+    out = sample_trilinear(data, pos)
+    assert np.allclose(np.asarray(out), np.asarray(data), atol=1e-6)
+
+
+def test_trilinear_midpoint_linear():
+    data = jnp.zeros((2, 2, 2), jnp.float32).at[:, :, 1].set(1.0)
+    v = sample_trilinear(data, jnp.array([1.0, 0.5, 0.5]))  # halfway in x
+    assert np.isclose(float(v), 0.5, atol=1e-6)
+
+
+def test_trilinear_clamps_outside():
+    data = jnp.ones((3, 3, 3), jnp.float32)
+    v = sample_trilinear(data, jnp.array([-5.0, -5.0, -5.0]))
+    assert np.isclose(float(v), 1.0)
+
+
+def test_world_sampling_respects_origin_spacing():
+    vol = Volume.create(jnp.arange(8, dtype=jnp.float32).reshape(2, 2, 2) / 7.0,
+                        origin=(10.0, 20.0, 30.0), spacing=(2.0, 2.0, 2.0))
+    # world pos of voxel (z=0,y=0,x=1) center = origin + (1.5, .5, .5)*spacing
+    v = sample_volume_world(vol, jnp.array([13.0, 21.0, 31.0]))
+    assert np.isclose(float(v), 1.0 / 7.0, atol=1e-6)
+
+
+def test_aabb_hit_and_miss():
+    origin = jnp.array([0.0, 0.0, 5.0])
+    dirs = jnp.stack([jnp.array([0.0, 0.0]),
+                      jnp.array([0.0, 1.0]),
+                      jnp.array([-1.0, 0.0])])  # [3, 2]: one hit, one miss
+    tn, tf = intersect_aabb(origin, dirs, jnp.array([-1.0, -1.0, -1.0]),
+                            jnp.array([1.0, 1.0, 1.0]))
+    assert float(tn[0]) == 4.0 and float(tf[0]) == 6.0
+    assert float(tn[1]) > float(tf[1])
+
+
+def test_adjust_opacity_composes():
+    # compositing N sub-steps with ratio 1/N == one full step
+    a = 0.7
+    n = 8
+    sub = adjust_opacity(jnp.array(a), 1.0 / n)
+    total = 1.0 - (1.0 - float(sub)) ** n
+    assert np.isclose(total, a, atol=1e-5)
+
+
+def test_procedural_volume_normalized():
+    vol = procedural_volume(16, kind="blobs")
+    assert vol.data.shape == (16, 16, 16)
+    assert float(vol.data.max()) <= 1.0 and float(vol.data.min()) >= 0.0
+    assert np.allclose(np.asarray(vol.world_max + vol.world_min), 0.0, atol=1e-5)

@@ -1,0 +1,113 @@
+"""Unit tests of the shared supersegment state machine on tiny synthetic
+streams (1x1 images so expected outputs are hand-checkable)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from scenery_insitu_tpu.ops import supersegments as ss
+
+
+def _feed(items, k=4, thr=0.5, gap_eps=-1.0):
+    """items: list of (rgba tuple, t0, t1). Returns (color [K,4], depth [K,2])."""
+    st = ss.init_state(k, 1, 1)
+    for rgba, t0, t1 in items:
+        st = ss.push(st, k, jnp.full((1, 1), thr),
+                     jnp.asarray(rgba, jnp.float32).reshape(4, 1, 1),
+                     jnp.full((1, 1), t0), jnp.full((1, 1), t1), gap_eps)
+    c, d = ss.finalize(st)
+    return np.asarray(c)[:, :, 0, 0], np.asarray(d)[:, :, 0, 0]
+
+
+def test_single_run_merges():
+    items = [((0.2, 0.0, 0.0, 0.5), 1.0, 1.1),
+             ((0.2, 0.0, 0.0, 0.5), 1.1, 1.2)]
+    c, d = _feed(items)
+    # one segment: alpha = 1-(1-.5)^2 = .75, extent [1.0, 1.2]
+    assert np.isclose(c[0, 3], 0.75, atol=1e-6)
+    assert np.allclose(d[0], [1.0, 1.2], atol=1e-6)
+    assert not np.isfinite(d[1, 0])
+
+
+def test_color_break_splits():
+    items = [((0.5, 0.0, 0.0, 0.5), 1.0, 1.1),
+             ((0.0, 0.5, 0.0, 0.5), 1.1, 1.2)]
+    c, d = _feed(items, thr=0.2)
+    assert c[0, 3] == 0.5 and c[1, 3] == 0.5
+    assert np.allclose(d[0], [1.0, 1.1]) and np.allclose(d[1], [1.1, 1.2])
+    assert c[0, 0] > 0.2 and c[1, 1] > 0.1  # first red, second green
+
+
+def test_gap_via_empty_sample():
+    items = [((0.2, 0.2, 0.2, 0.4), 1.0, 1.1),
+             ((0.0, 0.0, 0.0, 0.0), 1.1, 1.2),   # transparent gap
+             ((0.2, 0.2, 0.2, 0.4), 1.2, 1.3)]
+    c, d = _feed(items, thr=0.9)
+    assert c[0, 3] > 0 and c[1, 3] > 0
+    assert np.allclose(d[0], [1.0, 1.1]) and np.allclose(d[1], [1.2, 1.3])
+
+
+def test_gap_eps_breaks_segments():
+    items = [((0.2, 0.2, 0.2, 0.4), 1.0, 1.1),
+             ((0.2, 0.2, 0.2, 0.4), 2.0, 2.1)]   # same color, depth gap
+    c_nogap, d_nogap = _feed(items, thr=0.9, gap_eps=-1.0)
+    c_gap, d_gap = _feed(items, thr=0.9, gap_eps=0.01)
+    assert not np.isfinite(d_nogap[1, 0])        # merged without gap check
+    assert np.isfinite(d_gap[1, 0])              # split with gap check
+    assert np.allclose(d_gap[1], [2.0, 2.1])
+
+
+def test_overflow_merges_into_last_slot():
+    # alternating colors force a break at every item; k=2 → last slot absorbs
+    items = []
+    for i in range(6):
+        col = (0.8, 0.0, 0.0, 0.5) if i % 2 == 0 else (0.0, 0.8, 0.0, 0.5)
+        items.append((col, 1.0 + 0.1 * i, 1.1 + 0.1 * i))
+    c, d = _feed(items, k=2, thr=0.1)
+    assert np.isfinite(d[0, 0]) and np.isfinite(d[1, 0])
+    assert np.isclose(d[1, 1], 1.6, atol=1e-5)   # last slot extends to the end
+
+
+def test_alpha_under_ordering():
+    # opaque-ish first segment dominates the composited color
+    items = [((0.9, 0.0, 0.0, 0.9), 1.0, 1.1),
+             ((0.0, 0.9, 0.0, 0.9), 1.1, 1.2)]
+    c, _ = _feed(items, thr=0.2)
+    assert c[0, 0] > 5 * c[1, 1] * (1 - 0.9) or True  # segments stored separately
+    # re-compose front-to-back: red contribution >> green
+    total = c[0] + (1 - c[0][3]) * c[1]
+    assert total[0] > total[1] * 5
+
+
+def test_count_matches_write():
+    import jax
+    rng = np.random.default_rng(3)
+    h = w = 4
+    n = 24
+    vals = rng.random((n, h, w)).astype(np.float32)
+    alphas = (rng.random((n, h, w)) > 0.3).astype(np.float32) * 0.5
+    thr = jnp.full((h, w), 0.15, jnp.float32)
+    cstate = ss.init_count(h, w)
+    wstate = ss.init_state(8, h, w)
+    for i in range(n):
+        rgba = jnp.stack([jnp.asarray(vals[i]) * alphas[i],
+                          jnp.zeros((h, w)), jnp.zeros((h, w)),
+                          jnp.asarray(alphas[i])])
+        t0 = jnp.full((h, w), float(i))
+        t1 = t0 + 1.0
+        cstate = ss.push_count(cstate, thr, rgba)
+        wstate = ss.push(wstate, 8, thr, rgba, t0, t1)
+    color, depth = ss.finalize(wstate)
+    live = np.asarray((color[:, 3] > 0).sum(axis=0))
+    counts = np.asarray(cstate.count)
+    # where counts fit in k, written segments == counted segments
+    fits = counts <= 8
+    assert (live[fits] == counts[fits]).all()
+
+
+def test_adaptive_threshold_monotone():
+    # synthetic count function: higher threshold → fewer segments
+    def count_fn(thr):
+        return jnp.ceil(10.0 * (1.0 - thr / 2.0)).astype(jnp.int32)
+    thr = ss.adaptive_threshold(count_fn, 4, 8, 2, 2)
+    c = np.asarray(count_fn(thr))
+    assert (c <= 4).all()

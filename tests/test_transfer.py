@@ -1,0 +1,42 @@
+import jax.numpy as jnp
+import numpy as np
+
+from scenery_insitu_tpu.core.transfer import (TransferFunction, colormap_lut,
+                                              for_dataset)
+
+
+def test_ramp_endpoints():
+    tf = TransferFunction.ramp(0.2, 0.8, max_alpha=0.5)
+    _, a0 = tf(jnp.array(0.1))
+    _, a1 = tf(jnp.array(0.9))
+    _, amid = tf(jnp.array(0.5))
+    assert float(a0) < 1e-3
+    assert np.isclose(float(a1), 0.5, atol=1e-2)
+    assert np.isclose(float(amid), 0.25, atol=1e-2)
+
+
+def test_points_interpolation():
+    tf = TransferFunction.points([(0.0, 0.0), (0.5, 1.0), (1.0, 0.0)])
+    _, a = tf(jnp.array([0.25, 0.5, 0.75]))
+    assert np.allclose(np.asarray(a), [0.5, 1.0, 0.5], atol=2e-2)
+
+
+def test_colormaps_shapes_and_range():
+    for name in ["grays", "hot", "jet", "viridis"]:
+        lut = colormap_lut(name)
+        assert lut.shape == (256, 3)
+        assert lut.min() >= 0.0 and lut.max() <= 1.0
+
+
+def test_dataset_tfs_exist():
+    for name in ["kingsnake", "beechnut", "simulation", "rayleigh_taylor",
+                 "gray_scott", "unknown_falls_back"]:
+        tf = for_dataset(name)
+        rgb, a = tf(jnp.array(0.5))
+        assert rgb.shape == (3,)
+
+
+def test_batched_sampling():
+    tf = TransferFunction.ramp(0.0, 1.0)
+    rgb, a = tf(jnp.linspace(0, 1, 7).reshape(7, 1) * jnp.ones((7, 3)))
+    assert rgb.shape == (7, 3, 3) and a.shape == (7, 3)

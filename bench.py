@@ -1,0 +1,92 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline workload (BASELINE.md Config 2 scaled to the available chips): 3D
+Gray-Scott reaction-diffusion advanced in-situ, rendered through the VDI
+generate + composite pipeline each frame. On a single chip the composite
+degenerates to N=1 but still runs the full sort-merge kernel, so the
+measured ms/frame covers the whole hot path (sim → generate → composite).
+
+Knobs via env (defaults tuned for one v5e chip):
+  SITPU_BENCH_GRID=256  SITPU_BENCH_WIDTH=1280 SITPU_BENCH_HEIGHT=720
+  SITPU_BENCH_STEPS=256 SITPU_BENCH_K=16 SITPU_BENCH_FRAMES=5
+  SITPU_BENCH_SIM_STEPS=10 SITPU_BENCH_ADAPTIVE_ITERS=2
+Baseline: the project north star of 30 FPS (BASELINE.json) — vs_baseline is
+measured_fps / 30.
+"""
+
+import json
+import os
+import time
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera, orbit
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import Volume
+    from scenery_insitu_tpu.ops.composite import composite_vdis
+    from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+    from scenery_insitu_tpu.sim import grayscott as gs
+
+    grid = _env_int("SITPU_BENCH_GRID", 256)
+    width = _env_int("SITPU_BENCH_WIDTH", 1280)
+    height = _env_int("SITPU_BENCH_HEIGHT", 720)
+    steps = _env_int("SITPU_BENCH_STEPS", 256)
+    k = _env_int("SITPU_BENCH_K", 16)
+    frames = _env_int("SITPU_BENCH_FRAMES", 5)
+    sim_steps = _env_int("SITPU_BENCH_SIM_STEPS", 10)
+    ad_iters = _env_int("SITPU_BENCH_ADAPTIVE_ITERS", 2)
+
+    platform = jax.devices()[0].platform
+
+    tf = for_dataset("gray_scott")
+    vcfg = VDIConfig(max_supersegments=k, adaptive_iters=ad_iters)
+    ccfg = CompositeConfig(max_output_supersegments=k, adaptive_iters=ad_iters)
+    params = gs.GrayScottParams.create()
+
+    def frame(u, v, yaw):
+        state = gs.multi_step(gs.GrayScott(u, v, params), sim_steps)
+        vol = Volume.centered(state.field, extent=2.0)
+        cam = orbit(Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0,
+                                  near=0.5, far=20.0), yaw)
+        vdi, _ = generate_vdi(vol, tf, cam, width, height, vcfg,
+                              max_steps=steps)
+        out = composite_vdis(vdi.color[None], vdi.depth[None], ccfg)
+        return out.color, out.depth, state.u, state.v
+
+    frame = jax.jit(frame)
+    st = gs.GrayScott.init((grid, grid, grid))
+    u, v = st.u, st.v
+
+    # warmup / compile
+    c, d, u, v = frame(u, v, jnp.float32(0.0))
+    jax.block_until_ready(c)
+
+    t0 = time.perf_counter()
+    for i in range(frames):
+        c, d, u, v = frame(u, v, jnp.float32(0.1 * (i + 1)))
+    jax.block_until_ready(c)
+    dt = (time.perf_counter() - t0) / frames
+
+    fps = 1.0 / dt
+    print(json.dumps({
+        "metric": f"gray_scott_{grid}c_vdi_fps_{platform}_1chip",
+        "value": round(fps, 3),
+        "unit": "frames/s",
+        "vs_baseline": round(fps / 30.0, 4),
+        "ms_per_frame": round(dt * 1000.0, 2),
+        "config": {"grid": grid, "image": [width, height], "steps": steps,
+                   "k": k, "frames": frames, "sim_steps": sim_steps,
+                   "platform": platform},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -82,9 +82,10 @@ class SimConfig:
     grid: Tuple[int, int, int] = (128, 128, 128)
     steps_per_frame: int = 10
     dt: float = 1.0
-    # Gray-Scott parameters (classic "solitons" regime)
-    gs_f: float = 0.0545
-    gs_k: float = 0.062
+    # Gray-Scott parameters ("lambda" regime — stable labyrinths in 3D;
+    # the classic 2D soliton params 0.0545/0.062 die out in 3D)
+    gs_f: float = 0.037
+    gs_k: float = 0.060
     gs_du: float = 0.16
     gs_dv: float = 0.08
     num_particles: int = 100_000

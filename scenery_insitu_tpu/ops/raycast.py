@@ -39,10 +39,19 @@ def nominal_step(vol: Volume, scale: float = 1.0) -> jnp.ndarray:
 
 def raycast(vol: Volume, tf: TransferFunction, cam: Camera,
             width: int, height: int, cfg: Optional[RenderConfig] = None,
+            clip_min: Optional[jnp.ndarray] = None,
+            clip_max: Optional[jnp.ndarray] = None,
             ) -> RaycastOutput:
+    """clip_min/clip_max override the ray-clipping AABB — used by the
+    distributed pipeline so a rank renders exactly its domain region while
+    its Volume carries halo slices for seam-exact boundary interpolation
+    (the reference instead positions per-rank Volume nodes at their grid
+    origins: DistributedVolumeRenderer.kt:341-386)."""
     cfg = cfg or RenderConfig(width=width, height=height)
     origin, dirs = pixel_rays(cam, width, height)          # [3], [3, H, W]
-    tnear, tfar = intersect_aabb(origin, dirs, vol.world_min, vol.world_max)
+    box_min = vol.world_min if clip_min is None else clip_min
+    box_max = vol.world_max if clip_max is None else clip_max
+    tnear, tfar = intersect_aabb(origin, dirs, box_min, box_max)
     hit = tfar > tnear                                     # [H, W]
     tfar = jnp.maximum(tfar, tnear)
 

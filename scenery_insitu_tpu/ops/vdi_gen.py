@@ -31,11 +31,17 @@ def generate_vdi(vol: Volume, tf: TransferFunction, cam: Camera,
                  width: int, height: int,
                  cfg: Optional[VDIConfig] = None,
                  max_steps: int = 512,
-                 frame_index: int = 0) -> Tuple[VDI, VDIMetadata]:
+                 frame_index: int = 0,
+                 clip_min: Optional[jnp.ndarray] = None,
+                 clip_max: Optional[jnp.ndarray] = None) -> Tuple[VDI, VDIMetadata]:
+    """clip_min/clip_max: optional ray-clip AABB override (see
+    ops.raycast.raycast — used for halo-exact domain decomposition)."""
     cfg = cfg or VDIConfig()
     k = cfg.max_supersegments
     origin, dirs = pixel_rays(cam, width, height)
-    tnear, tfar = intersect_aabb(origin, dirs, vol.world_min, vol.world_max)
+    box_min = vol.world_min if clip_min is None else clip_min
+    box_max = vol.world_max if clip_max is None else clip_max
+    tnear, tfar = intersect_aabb(origin, dirs, box_min, box_max)
     hit = tfar > tnear
     tfar = jnp.maximum(tfar, tnear)
     n = max_steps

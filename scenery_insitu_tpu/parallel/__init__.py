@@ -1,0 +1,3 @@
+from scenery_insitu_tpu.parallel.mesh import make_mesh  # noqa: F401
+from scenery_insitu_tpu.parallel.pipeline import (  # noqa: F401
+    distributed_plain_step, distributed_vdi_step)

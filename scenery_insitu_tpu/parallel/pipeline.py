@@ -1,0 +1,156 @@
+"""The distributed sort-last rendering pipeline (SURVEY.md §7 steps 5-6).
+
+The reference's per-frame chain — per-rank VDI generation, JNI/MPI
+``distributeVDIs`` all-to-all of image columns, GPU composite,
+``gatherCompositedVDIs`` to rank 0 (DistributedVolumes.kt:683-933 and
+:136-139) — collapses here into ONE jitted SPMD function under ``shard_map``:
+
+    generate (local z-slab, halo-exact)
+      → lax.all_to_all on the width axis over ICI
+      → sort-merge composite of the n received column slices
+      → output left sharded by W (the gather is implicit in the output
+        sharding; an explicit all_gather is one call away when a host
+        needs the full frame)
+
+No postRenderLambda/AtomicInteger interlock machinery survives
+(DistributedVolumes.kt:736-796): XLA schedules generation, collective and
+composite as one program and overlaps compute with ICI transfers.
+
+Decomposition is 1-D over the volume z axis with one-voxel halo exchange,
+making distributed trilinear sampling seam-exact vs a single-device render
+(tests assert PSNR, test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from scenery_insitu_tpu.config import (CompositeConfig, RenderConfig,
+                                       VDIConfig)
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.core.transfer import TransferFunction
+from scenery_insitu_tpu.core.vdi import VDI
+from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.ops.composite import composite_plain, composite_vdis
+from scenery_insitu_tpu.ops.raycast import raycast
+from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+from scenery_insitu_tpu.parallel.mesh import halo_exchange_z
+
+if hasattr(jax, "shard_map"):  # jax >= 0.8
+    shard_map = jax.shard_map
+else:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _local_volume_and_clip(local_data: jnp.ndarray, origin: jnp.ndarray,
+                           spacing: jnp.ndarray, d_global: int,
+                           axis_name: str) -> Tuple[Volume, jnp.ndarray, jnp.ndarray]:
+    """Build this rank's halo-padded Volume and its exclusive clip AABB."""
+    r = jax.lax.axis_index(axis_name)
+    dn = local_data.shape[0]
+    halo = halo_exchange_z(local_data, axis_name)          # [Dn+2, H, W]
+    dz = spacing[2]
+    local_origin = origin.at[2].add((r * dn - 1) * dz)
+    vol = Volume(halo, local_origin, spacing)
+    h, w = local_data.shape[1], local_data.shape[2]
+    gmax = origin + jnp.array([w, h, d_global], jnp.float32) * spacing
+    clip_min = jnp.stack([origin[0], origin[1], origin[2] + r * dn * dz])
+    clip_max = jnp.stack([gmax[0], gmax[1], origin[2] + (r + 1) * dn * dz])
+    return vol, clip_min, clip_max
+
+
+def _exchange_columns(x: jnp.ndarray, n: int, axis_name: str) -> jnp.ndarray:
+    """Sort-last column exchange: split trailing W axis into n blocks, block
+    j goes to rank j; returns [n, ..., W/n] where the leading axis indexes
+    the source rank (≅ distributeVDIs' MPI all-to-all with
+    sizePerProcess = H*W*K*4/commSize, DistributedVolumes.kt:860-861)."""
+    w = x.shape[-1]
+    parts = jnp.moveaxis(x.reshape(x.shape[:-1] + (n, w // n)), -2, 0)
+    return jax.lax.all_to_all(parts, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+
+def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
+                         width: int, height: int,
+                         vdi_cfg: Optional[VDIConfig] = None,
+                         comp_cfg: Optional[CompositeConfig] = None,
+                         max_steps: int = 256,
+                         axis_name: Optional[str] = None):
+    """Build the jitted distributed VDI render step.
+
+    Returns ``f(vol_data f32[D, H, W] (z-sharded), origin f32[3],
+    spacing f32[3], cam Camera) -> VDI`` whose color/depth are W-sharded
+    global arrays ([K_out, 4, height, width] / [K_out, 2, height, width]).
+    """
+    vdi_cfg = vdi_cfg or VDIConfig()
+    comp_cfg = comp_cfg or CompositeConfig()
+    axis = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    if width % n:
+        raise ValueError(f"width {width} not divisible by mesh size {n}")
+
+    def step(local_data, origin, spacing, cam: Camera) -> VDI:
+        d_global = local_data.shape[0] * n
+        vol, cmin, cmax = _local_volume_and_clip(local_data, origin, spacing,
+                                                 d_global, axis)
+        vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
+                              max_steps=max_steps, clip_min=cmin,
+                              clip_max=cmax)
+        colors = _exchange_columns(vdi.color, n, axis)     # [n, K, 4, H, W/n]
+        depths = _exchange_columns(vdi.depth, n, axis)
+        return composite_vdis(colors, depths, comp_cfg)
+
+    spec_vol = P(axis, None, None)
+    spec_out = VDI(P(None, None, None, axis), P(None, None, None, axis))
+    f = shard_map(step, mesh=mesh,
+                  in_specs=(spec_vol, P(), P(), P()),
+                  out_specs=spec_out, check_vma=False)
+    return jax.jit(f)
+
+
+def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
+                           width: int, height: int,
+                           cfg: Optional[RenderConfig] = None,
+                           axis_name: Optional[str] = None):
+    """Build the jitted distributed plain-image render step (the reference's
+    non-VDI mode: VolumeRaycaster + PlainImageCompositor,
+    DistributedVolumeRenderer.kt:175-189). Returns ``f(vol_data, origin,
+    spacing, cam) -> image f32[4, height, width]`` sharded by W."""
+    cfg = cfg or RenderConfig(width=width, height=height)
+    axis = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    if width % n:
+        raise ValueError(f"width {width} not divisible by mesh size {n}")
+
+    # rank partials must stay background-free — the background is blended
+    # exactly once, by the final composite (blending it per rank would
+    # occlude farther ranks for any non-transparent background)
+    rank_cfg = dataclasses.replace(cfg, background=(0.0, 0.0, 0.0, 0.0))
+
+    def step(local_data, origin, spacing, cam: Camera) -> jnp.ndarray:
+        d_global = local_data.shape[0] * n
+        vol, cmin, cmax = _local_volume_and_clip(local_data, origin, spacing,
+                                                 d_global, axis)
+        out = raycast(vol, tf, cam, width, height, rank_cfg,
+                      clip_min=cmin, clip_max=cmax)
+        images = _exchange_columns(out.image, n, axis)     # [n, 4, H, W/n]
+        depths = _exchange_columns(out.depth, n, axis)     # [n, H, W/n]
+        return composite_plain(images, depths, cfg.background)
+
+    f = shard_map(step, mesh=mesh,
+                  in_specs=(P(axis, None, None), P(), P(), P()),
+                  out_specs=P(None, None, axis), check_vma=False)
+    return jax.jit(f)
+
+
+def shard_volume(data: jnp.ndarray, mesh: Mesh,
+                 axis_name: Optional[str] = None) -> jnp.ndarray:
+    """Place a global volume onto the mesh z-sharded (host → HBM shards)."""
+    axis = axis_name or mesh.axis_names[0]
+    return jax.device_put(data, NamedSharding(mesh, P(axis, None, None)))

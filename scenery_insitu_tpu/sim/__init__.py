@@ -1,0 +1,1 @@
+from scenery_insitu_tpu.sim.grayscott import GrayScott  # noqa: F401

@@ -1,0 +1,125 @@
+"""Distribution-layer tests on the virtual 8-device CPU mesh: halo
+exactness, all-to-all plumbing, and distributed-vs-single-device render
+parity (the checks the reference could only do by eyeballing cluster runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from scenery_insitu_tpu.config import CompositeConfig, RenderConfig, VDIConfig
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.core.transfer import TransferFunction
+from scenery_insitu_tpu.core.vdi import render_vdi_same_view
+from scenery_insitu_tpu.core.volume import Volume, procedural_volume
+from scenery_insitu_tpu.ops.raycast import raycast
+from scenery_insitu_tpu.parallel.mesh import (halo_exchange_z, make_mesh,
+                                              volume_sharding)
+from scenery_insitu_tpu.parallel.pipeline import (distributed_plain_step,
+                                                  distributed_vdi_step,
+                                                  shard_volume)
+from scenery_insitu_tpu.utils.image import psnr
+
+W = H = 16
+STEPS = 48
+
+
+def _cam():
+    return Camera.create((0.0, 0.2, 4.0), fov_y_deg=50.0, near=0.5, far=20.0)
+
+
+def _tf():
+    return TransferFunction.ramp(0.05, 0.8, 0.7)
+
+
+def test_mesh_creation():
+    mesh = make_mesh(4)
+    assert mesh.shape["ranks"] == 4
+    mesh8 = make_mesh()
+    assert mesh8.shape["ranks"] == 8
+
+
+def test_halo_exchange_matches_global():
+    mesh = make_mesh(4)
+    d = 8
+    data = jnp.arange(d * 2 * 2, dtype=jnp.float32).reshape(d, 2, 2)
+
+    f = jax.jit(jax.shard_map(
+        lambda x: halo_exchange_z(x),
+        mesh=mesh, in_specs=P("ranks", None, None),
+        out_specs=P("ranks", None, None), check_vma=False))
+    out = np.asarray(f(data))                     # [4*(2+2), 2, 2] stacked
+    dn = d // 4
+    blocks = out.reshape(4, dn + 2, 2, 2)
+    gd = np.asarray(data)
+    for r in range(4):
+        lo = max(r * dn - 1, 0)
+        hi = min((r + 1) * dn + 1, d)
+        expect = gd[lo:hi]
+        if r == 0:
+            expect = np.concatenate([gd[:1], expect], axis=0)
+        if r == 3:
+            expect = np.concatenate([expect, gd[-1:]], axis=0)
+        assert np.array_equal(blocks[r], expect), r
+
+
+def test_shard_volume_layout():
+    mesh = make_mesh(4)
+    data = jnp.zeros((8, 4, 4))
+    sharded = shard_volume(data, mesh)
+    assert sharded.sharding == volume_sharding(mesh)
+
+
+@pytest.mark.parametrize("n,background", [(2, (0, 0, 0, 0)), (4, (0, 0, 0, 0)),
+                                          (4, (1.0, 0.2, 0.1, 1.0))])
+def test_distributed_plain_matches_single(n, background):
+    mesh = make_mesh(n)
+    vol = procedural_volume(16, kind="shell")
+    cfg = RenderConfig(max_steps=STEPS, early_exit_alpha=1.1,
+                       background=background)
+    cam = _cam()
+    ref = np.asarray(raycast(vol, _tf(), cam, W, H, cfg).image)
+
+    step = distributed_plain_step(mesh, _tf(), W, H, cfg)
+    img = np.asarray(step(shard_volume(vol.data, mesh), vol.origin,
+                          vol.spacing, cam))
+    assert img.shape == (4, H, W)
+    assert psnr(ref, img) > 28.0, psnr(ref, img)
+
+
+def test_distributed_vdi_matches_single():
+    n = 4
+    mesh = make_mesh(n)
+    vol = procedural_volume(16, kind="blobs")
+    cam = _cam()
+    ref = np.asarray(raycast(vol, _tf(), cam, W, H,
+                             RenderConfig(max_steps=STEPS,
+                                          early_exit_alpha=1.1)).image)
+    step = distributed_vdi_step(
+        mesh, _tf(), W, H,
+        VDIConfig(max_supersegments=10, adaptive_iters=4),
+        CompositeConfig(max_output_supersegments=16), max_steps=STEPS)
+    vdi = step(shard_volume(vol.data, mesh), vol.origin, vol.spacing, cam)
+    assert vdi.color.shape == (16, 4, H, W)
+    img = np.asarray(render_vdi_same_view(vdi))
+    assert psnr(ref, img) > 25.0, psnr(ref, img)
+
+
+def test_distributed_vdi_output_sharding():
+    mesh = make_mesh(2)
+    vol = procedural_volume(8)
+    step = distributed_vdi_step(mesh, _tf(), W, H,
+                                VDIConfig(max_supersegments=6,
+                                          adaptive=False, threshold=0.1),
+                                max_steps=16)
+    vdi = step(shard_volume(vol.data, mesh), vol.origin, vol.spacing, _cam())
+    # composited output is W-sharded: each rank owns its column block
+    spec = vdi.color.sharding.spec
+    assert spec[-1] == "ranks", spec
+
+
+def test_width_divisibility_check():
+    mesh = make_mesh(4)
+    with pytest.raises(ValueError):
+        distributed_vdi_step(mesh, _tf(), 18, H)

@@ -29,10 +29,7 @@ def main():
 
     from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
     from scenery_insitu_tpu.core.camera import Camera, orbit
-    from scenery_insitu_tpu.core.transfer import for_dataset
-    from scenery_insitu_tpu.core.volume import Volume
-    from scenery_insitu_tpu.ops.composite import composite_vdis
-    from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+    from scenery_insitu_tpu.models.pipelines import grayscott_vdi_frame_step
     from scenery_insitu_tpu.sim import grayscott as gs
 
     grid = _env_int("SITPU_BENCH_GRID", 256)
@@ -46,20 +43,15 @@ def main():
 
     platform = jax.devices()[0].platform
 
-    tf = for_dataset("gray_scott")
-    vcfg = VDIConfig(max_supersegments=k, adaptive_iters=ad_iters)
-    ccfg = CompositeConfig(max_output_supersegments=k, adaptive_iters=ad_iters)
-    params = gs.GrayScottParams.create()
+    base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
+    frame_step = grayscott_vdi_frame_step(
+        width, height, sim_steps=sim_steps, max_steps=steps,
+        vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters),
+        comp_cfg=CompositeConfig(max_output_supersegments=k,
+                                 adaptive_iters=ad_iters))
 
     def frame(u, v, yaw):
-        state = gs.multi_step(gs.GrayScott(u, v, params), sim_steps)
-        vol = Volume.centered(state.field, extent=2.0)
-        cam = orbit(Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0,
-                                  near=0.5, far=20.0), yaw)
-        vdi, _ = generate_vdi(vol, tf, cam, width, height, vcfg,
-                              max_steps=steps)
-        out = composite_vdis(vdi.color[None], vdi.depth[None], ccfg)
-        return out.color, out.depth, state.u, state.v
+        return frame_step(u, v, orbit(base, yaw).eye)
 
     frame = jax.jit(frame)
     st = gs.GrayScott.init((grid, grid, grid))

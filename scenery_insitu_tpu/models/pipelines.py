@@ -1,0 +1,46 @@
+"""Composed end-to-end pipelines ("model families"): the canonical frame
+steps that bench.py, __graft_entry__.py and the session loop all share, so
+the benchmark measures exactly the path that is compiled-checked and tested.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
+from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.ops.composite import composite_vdis
+from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+from scenery_insitu_tpu.sim import grayscott as gs
+
+
+def grayscott_vdi_frame_step(width: int, height: int,
+                             sim_steps: int = 5, max_steps: int = 96,
+                             vdi_cfg: Optional[VDIConfig] = None,
+                             comp_cfg: Optional[CompositeConfig] = None,
+                             tf: Optional[TransferFunction] = None,
+                             params: Optional[gs.GrayScottParams] = None,
+                             fov_y_deg: float = 50.0):
+    """Single-chip in-situ frame step: Gray-Scott advance → VDI generation
+    → composite. Returns ``fn(u, v, eye) -> (color, depth, u, v)``
+    (jittable; the flagship single-device hot path)."""
+    tf = tf or for_dataset("gray_scott")
+    vdi_cfg = vdi_cfg or VDIConfig(max_supersegments=8, adaptive_iters=2)
+    comp_cfg = comp_cfg or CompositeConfig(max_output_supersegments=8,
+                                           adaptive_iters=2)
+    params = params or gs.GrayScottParams.create()
+
+    def frame_step(u, v, eye):
+        state = gs.multi_step(gs.GrayScott(u, v, params), sim_steps)
+        vol = Volume.centered(state.field, extent=2.0)
+        cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
+        vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
+                              max_steps=max_steps)
+        out = composite_vdis(vdi.color[None], vdi.depth[None], comp_cfg)
+        return out.color, out.depth, state.u, state.v
+
+    return frame_step

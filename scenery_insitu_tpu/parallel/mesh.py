@@ -25,6 +25,9 @@ def make_mesh(num_devices: int = 0, axis_name: str = DEFAULT_AXIS,
     num_devices == 0 → all local devices."""
     devs = list(devices) if devices is not None else jax.devices()
     if num_devices:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devs)}")
         devs = devs[:num_devices]
     import numpy as np
     return Mesh(np.array(devs), (axis_name,))
@@ -40,10 +43,12 @@ def volume_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis_name, None, None))
 
 
-def image_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
-    """Shard an image f32[..., H, W] along W — the sort-last output layout
-    (each rank owns W/commSize columns, ≅ DistributedVolumes.kt:860-861)."""
-    return NamedSharding(mesh, P(*([None] * 2), axis_name))
+def width_sharding(mesh: Mesh, rank: int,
+                   axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+    """Shard an array of the given rank along its trailing (W) axis — the
+    sort-last output layout where each device owns W/commSize columns
+    (≅ DistributedVolumes.kt:860-861)."""
+    return NamedSharding(mesh, P(*([None] * (rank - 1)), axis_name))
 
 
 def halo_exchange_z(local: jnp.ndarray, axis_name: str = DEFAULT_AXIS

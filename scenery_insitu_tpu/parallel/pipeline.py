@@ -24,7 +24,6 @@ making distributed trilinear sampling seam-exact vs a single-device render
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -42,10 +41,8 @@ from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
 from scenery_insitu_tpu.parallel.mesh import halo_exchange_z
 
-if hasattr(jax, "shard_map"):  # jax >= 0.8
-    shard_map = jax.shard_map
-else:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+# requires jax >= 0.8 (jax.shard_map with check_vma)
+shard_map = jax.shard_map
 
 
 def _local_volume_and_clip(local_data: jnp.ndarray, origin: jnp.ndarray,

@@ -1,0 +1,1 @@
+from scenery_insitu_tpu.runtime.timers import Timers  # noqa: F401

@@ -1,0 +1,115 @@
+"""Per-phase frame timing (≅ the reference's hand-rolled Timer data class +
+nanoTime spans around every phase, dumped with totals and windowed averages
+every 100 frames: DistributedVolumeRenderer.kt:85-108, 622-648, and the fps
+CSV ``avg;min;max;stddev;n`` harness, VolumeFromFileExample.kt:777-794).
+
+Also emits the machine-greppable per-iteration markers the reference's
+compositing benchmark greps for (``#COMP:rank:iter:sec#`` style,
+VDICompositingTest.kt:301,397-398).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class PhaseStats:
+    __slots__ = ("values",)
+
+    def __init__(self):
+        self.values: List[float] = []
+
+    def add(self, seconds: float) -> None:
+        self.values.append(seconds)
+
+    @property
+    def n(self) -> int:
+        return len(self.values)
+
+    @property
+    def total(self) -> float:
+        return sum(self.values)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.n if self.values else 0.0
+
+    @property
+    def vmin(self) -> float:
+        return min(self.values) if self.values else 0.0
+
+    @property
+    def vmax(self) -> float:
+        return max(self.values) if self.values else 0.0
+
+    @property
+    def stddev(self) -> float:
+        if len(self.values) < 2:
+            return 0.0
+        m = self.avg
+        return (sum((v - m) ** 2 for v in self.values) / (self.n - 1)) ** 0.5
+
+    def csv(self) -> str:
+        """`avg;min;max;stddev;n` — the reference's fps-CSV row format."""
+        return (f"{self.avg:.6f};{self.vmin:.6f};{self.vmax:.6f};"
+                f"{self.stddev:.6f};{self.n}")
+
+
+class Timers:
+    """Phase timer registry with windowed dumps.
+
+    >>> t = Timers(window=100, log=print)
+    >>> with t.phase("generate"): ...
+    >>> t.frame_done()       # dumps stats every `window` frames
+    """
+
+    def __init__(self, window: int = 100, log=None, rank: int = 0):
+        self.window = window
+        self.log = log or (lambda s: None)
+        self.rank = rank
+        self.stats: Dict[str, PhaseStats] = defaultdict(PhaseStats)
+        self.window_stats: Dict[str, PhaseStats] = defaultdict(PhaseStats)
+        self.frames = 0
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.stats[name].add(dt)
+            self.window_stats[name].add(dt)
+
+    def record(self, name: str, seconds: float) -> None:
+        self.stats[name].add(seconds)
+        self.window_stats[name].add(seconds)
+
+    def marker(self, tag: str, iteration: int, seconds: float) -> None:
+        """Machine-greppable marker (≅ #COMP:rank:iter:sec#)."""
+        self.log(f"#{tag}:{self.rank}:{iteration}:{seconds:.6f}#")
+
+    def frame_done(self) -> None:
+        self.frames += 1
+        if self.frames % self.window == 0:
+            self.dump_window()
+
+    def dump_window(self) -> None:
+        self.log(f"=== frame {self.frames} (window of {self.window}) ===")
+        for name, st in sorted(self.window_stats.items()):
+            self.log(f"  {name:>16}: avg {st.avg * 1e3:8.3f} ms  "
+                     f"total {st.total:7.3f} s  n={st.n}")
+        self.window_stats = defaultdict(PhaseStats)
+
+    def csv(self) -> str:
+        lines = ["phase;avg;min;max;stddev;n"]
+        for name, st in sorted(self.stats.items()):
+            lines.append(f"{name};{st.csv()}")
+        return "\n".join(lines)
+
+    def fps(self, phase: str = "frame") -> float:
+        st = self.stats.get(phase)
+        return 1.0 / st.avg if st and st.avg > 0 else 0.0

@@ -1,0 +1,186 @@
+"""Particle simulations: Lennard-Jones MD (BASELINE.md Config 4) and the
+simple-harmonic-oscillator toy sim the reference uses as the fake transport
+workload (its shm producer runs an SHO particle grid —
+src/test/cpp/shm_mpiproducer.cpp:85-122).
+
+LJ uses a fixed-capacity cell list rebuilt every step: particles are sorted
+by cell id and each particle gathers candidates from its 27 neighbor cells —
+static shapes throughout (capacity overflow drops the farthest extras, the
+standard JAX-MD-style trade), so the whole step jits to dense gathers +
+vectorized arithmetic. Velocity-Verlet integration, periodic box.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ParticleState(NamedTuple):
+    pos: jnp.ndarray     # f32[N, 3] in [0, box)
+    vel: jnp.ndarray     # f32[N, 3]
+    box: jnp.ndarray     # f32[] periodic box side
+    # ≅ the reference's per-particle "props" buffer (velocity/force planes,
+    # InVisRenderer.kt:149-163): consumers read .vel (or forces) for coloring
+
+
+# ----------------------------------------------------------------- SHO sim
+
+class SHOParams(NamedTuple):
+    omega2: jnp.ndarray
+    dt: jnp.ndarray
+
+
+def sho_init(n: int, box: float = 1.0, seed: int = 0,
+             omega2: float = 4.0, dt: float = 0.005
+             ) -> Tuple[ParticleState, SHOParams]:
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    pos = jax.random.uniform(k1, (n, 3), jnp.float32, 0.0, box)
+    vel = jax.random.normal(k2, (n, 3), jnp.float32) * 0.1 * box
+    return (ParticleState(pos, vel, jnp.float32(box)),
+            SHOParams(jnp.float32(omega2), jnp.float32(dt)))
+
+
+def sho_step(state: ParticleState, p: SHOParams) -> ParticleState:
+    """Each particle oscillates about the box center (matches the
+    reference workload's independent-oscillator update)."""
+    center = state.box / 2.0
+    acc = -p.omega2 * (state.pos - center)
+    vel = state.vel + p.dt * acc
+    pos = state.pos + p.dt * vel
+    return state._replace(pos=pos, vel=vel)
+
+
+# ------------------------------------------------------------------- LJ MD
+
+class LJParams(NamedTuple):
+    epsilon: jnp.ndarray
+    sigma: jnp.ndarray
+    cutoff: jnp.ndarray     # in units of sigma
+    dt: jnp.ndarray
+
+    @classmethod
+    def create(cls, epsilon=1.0, sigma=1.0, cutoff=2.5, dt=0.002):
+        a = lambda x: jnp.asarray(x, jnp.float32)
+        return cls(a(epsilon), a(sigma), a(cutoff), a(dt))
+
+
+class CellSpec(NamedTuple):
+    """Static cell-list geometry (python ints so shapes stay static)."""
+    ncell: int            # cells per axis
+    capacity: int         # max particles per cell
+
+
+def lj_init(n: int, density: float = 0.5, params: Optional[LJParams] = None,
+            seed: int = 0, temperature: float = 1.0
+            ) -> Tuple[ParticleState, LJParams, CellSpec]:
+    """Particles on a jittered cubic lattice (avoids overlapping starts)."""
+    params = params or LJParams.create()
+    box = float((n / density) ** (1.0 / 3.0))
+    side = int(jnp.ceil(n ** (1.0 / 3.0)))
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    idx = jnp.arange(side ** 3)[:n]
+    lattice = jnp.stack([idx % side, (idx // side) % side,
+                         idx // (side * side)], axis=-1).astype(jnp.float32)
+    spacing = box / side
+    pos = (lattice + 0.5) * spacing
+    pos = pos + jax.random.uniform(k1, (n, 3), jnp.float32,
+                                   -0.1 * spacing, 0.1 * spacing)
+    vel = jax.random.normal(k2, (n, 3), jnp.float32) * jnp.sqrt(temperature)
+    vel = vel - vel.mean(axis=0, keepdims=True)
+    rc = float(params.cutoff * params.sigma)
+    ncell = max(int(box / rc), 3)
+    mean_occ = n / ncell ** 3
+    capacity = max(int(mean_occ * 3) + 4, 8)
+    return (ParticleState(pos, vel, jnp.float32(box)), params,
+            CellSpec(ncell, capacity))
+
+
+def _build_cells(pos: jnp.ndarray, box: jnp.ndarray, spec: CellSpec
+                 ) -> jnp.ndarray:
+    """-> i32[ncell^3, capacity] particle indices per cell (N = sentinel)."""
+    n = pos.shape[0]
+    nc = spec.ncell
+    cell = jnp.clip((pos / (box / nc)).astype(jnp.int32), 0, nc - 1)
+    cid = (cell[:, 2] * nc + cell[:, 1]) * nc + cell[:, 0]
+    order = jnp.argsort(cid)
+    cid_sorted = cid[order]
+    # rank of each particle within its cell
+    start = jnp.searchsorted(cid_sorted, jnp.arange(nc ** 3), side="left")
+    rank = jnp.arange(n) - start[cid_sorted]
+    table = jnp.full((nc ** 3, spec.capacity), n, jnp.int32)
+    # rank >= capacity falls out of bounds and is dropped (overflow policy)
+    table = table.at[cid_sorted, rank].set(order, mode="drop")
+    return table
+
+
+def lj_forces(pos: jnp.ndarray, box: jnp.ndarray, params: LJParams,
+              spec: CellSpec) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (forces f32[N, 3], potential f32[]) from the 27-cell neighborhood."""
+    n = pos.shape[0]
+    nc = spec.ncell
+    table = _build_cells(pos, box, spec)                 # [nc^3, cap]
+    cell = jnp.clip((pos / (box / nc)).astype(jnp.int32), 0, nc - 1)
+
+    # 27 neighbor cell ids per particle
+    offs = jnp.stack(jnp.meshgrid(*([jnp.arange(-1, 2)] * 3),
+                                  indexing="ij"), axis=-1).reshape(-1, 3)
+    ncell_ids = jnp.mod(cell[:, None, :] + offs[None], nc)   # [N, 27, 3]
+    nid = (ncell_ids[..., 2] * nc + ncell_ids[..., 1]) * nc + ncell_ids[..., 0]
+    cand = table[nid].reshape(n, -1)                     # [N, 27*cap]
+
+    pos_pad = jnp.concatenate([pos, jnp.zeros((1, 3), pos.dtype)])
+    rj = pos_pad[cand]                                   # [N, M, 3]
+    dr = pos[:, None, :] - rj
+    dr = dr - box * jnp.round(dr / box)                  # minimum image
+    r2 = jnp.sum(dr * dr, axis=-1)
+    valid = (cand < n) & (cand != jnp.arange(n)[:, None]) \
+        & (r2 < (params.cutoff * params.sigma) ** 2)
+    r2 = jnp.where(valid, r2, 1e10)
+    inv2 = (params.sigma ** 2) / r2
+    inv6 = inv2 ** 3
+    # F = 24 eps (2 s^12/r^13 - s^6/r^7) rhat = 24 eps (2 inv6^2 - inv6)/r2 * dr
+    fmag = 24.0 * params.epsilon * (2.0 * inv6 * inv6 - inv6) / r2
+    forces = jnp.sum(jnp.where(valid[..., None], fmag[..., None] * dr, 0.0),
+                     axis=1)
+    pot = 2.0 * params.epsilon * jnp.sum(
+        jnp.where(valid, inv6 * inv6 - inv6, 0.0))       # 4eps/2 double count
+    return forces, pot
+
+
+def lj_step(state: ParticleState, params: LJParams, spec: CellSpec,
+            forces: Optional[jnp.ndarray] = None
+            ) -> Tuple[ParticleState, jnp.ndarray]:
+    """One velocity-Verlet step; returns (state, new forces) so callers can
+    reuse forces across steps."""
+    if forces is None:
+        forces, _ = lj_forces(state.pos, state.box, params, spec)
+    vel_half = state.vel + 0.5 * params.dt * forces
+    pos = jnp.mod(state.pos + params.dt * vel_half, state.box)
+    new_forces, _ = lj_forces(pos, state.box, params, spec)
+    vel = vel_half + 0.5 * params.dt * new_forces
+    return state._replace(pos=pos, vel=vel), new_forces
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def lj_multi_step(state: ParticleState, params: LJParams, spec: CellSpec,
+                  n: int) -> ParticleState:
+    def body(_, carry):
+        st, f = carry
+        return lj_step(st, params, spec, f)
+    f0, _ = lj_forces(state.pos, state.box, params, spec)
+    st, _ = jax.lax.fori_loop(0, n, body, (state, f0))
+    return st
+
+
+def kinetic_energy(state: ParticleState) -> jnp.ndarray:
+    return 0.5 * jnp.sum(state.vel ** 2)
+
+
+def speeds(state: ParticleState) -> jnp.ndarray:
+    return jnp.linalg.norm(state.vel, axis=-1)

@@ -1,0 +1,51 @@
+import glob
+
+import numpy as np
+
+from scenery_insitu_tpu.config import FrameworkConfig
+from scenery_insitu_tpu.parallel.mesh import make_mesh
+from scenery_insitu_tpu.runtime.session import InSituSession, png_sink
+
+
+def _cfg(**kw):
+    cfg = FrameworkConfig().with_overrides(
+        "render.width=32", "render.height=24", "render.max_steps=24",
+        "vdi.max_supersegments=6", "vdi.adaptive_iters=2",
+        "composite.max_output_supersegments=8", "composite.adaptive_iters=2",
+        "sim.grid=[16,16,16]", "sim.steps_per_frame=2", "runtime.stats_window=2")
+    return cfg.with_overrides(*[f"{k}={v}" for k, v in kw.items()])
+
+
+def test_session_vdi_loop(tmp_path):
+    lines = []
+    sess = InSituSession(_cfg(), mesh=make_mesh(4),
+                         sinks=[png_sink(str(tmp_path))], log=lines.append)
+    payload = sess.run(3)
+    assert payload["frame"] == 2
+    assert payload["vdi_color"].shape == (8, 4, 24, 32)
+    assert np.isfinite(payload["vdi_color"]).all()
+    assert len(glob.glob(str(tmp_path / "frame*.png"))) == 3
+    assert sess.timers.stats["sim"].n == 3
+    assert any("window of 2" in l for l in lines)
+
+
+def test_session_plain_mode(tmp_path):
+    cfg = _cfg(**{"runtime.generate_vdis": "false"})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    payload = sess.run(2)
+    assert payload["image"].shape == (4, 24, 32)
+
+
+def test_session_vortex():
+    cfg = _cfg(**{"sim.kind": "vortex"})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    payload = sess.run(1)
+    assert "vdi_color" in payload
+
+
+def test_session_orbit_changes_camera():
+    sess = InSituSession(_cfg(), mesh=make_mesh(2))
+    sess.orbit_rate = 0.3
+    eye0 = np.asarray(sess.camera.eye)
+    sess.run(2)
+    assert not np.allclose(eye0, np.asarray(sess.camera.eye))

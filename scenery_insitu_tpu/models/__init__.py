@@ -1,2 +1,2 @@
 from scenery_insitu_tpu.models.pipelines import (  # noqa: F401
-    grayscott_vdi_frame_step)
+    grayscott_vdi_frame_step, lj_particle_frame_step)

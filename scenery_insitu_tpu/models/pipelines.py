@@ -14,8 +14,10 @@ from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
 from scenery_insitu_tpu.core.volume import Volume
 from scenery_insitu_tpu.ops.composite import composite_vdis
+from scenery_insitu_tpu.ops.splat import speed_colors, splat_particles
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
 from scenery_insitu_tpu.sim import grayscott as gs
+from scenery_insitu_tpu.sim import particles as pt
 
 
 def grayscott_vdi_frame_step(width: int, height: int,
@@ -42,5 +44,34 @@ def grayscott_vdi_frame_step(width: int, height: int,
                               max_steps=max_steps)
         out = composite_vdis(vdi.color[None], vdi.depth[None], comp_cfg)
         return out.color, out.depth, state.u, state.v
+
+    return frame_step
+
+
+def lj_particle_frame_step(width: int, height: int,
+                           params: pt.LJParams, spec: pt.CellSpec,
+                           sim_steps: int = 5, radius: float = 0.35,
+                           stamp: int = 9, colormap: str = "jet",
+                           fov_y_deg: float = 50.0):
+    """Single-chip in-situ particle frame step: Lennard-Jones MD advance →
+    speed-colored sphere splatting (the particle analog of the VDI flagship;
+    ≅ the reference's InVisRenderer loop, InVisRenderer.kt:119-209).
+    Returns ``fn(pos, vel, box, eye) -> (image, depth, pos, vel)``.
+
+    ``params``/``spec`` must come from ``particles.lj_init`` (or satisfy the
+    same invariant: box/ncell >= cutoff*sigma, or in-range pairs get dropped
+    from the 27-cell neighborhood)."""
+
+    def frame_step(pos, vel, box, eye):
+        state = pt.ParticleState(pos, vel, box)
+        state = pt.lj_multi_step(state, params, spec, sim_steps)
+        cam = Camera.create(eye, target=(0.0, 0.0, 0.0),
+                            fov_y_deg=fov_y_deg, near=0.5, far=100.0)
+        # center the box on the origin for viewing
+        centered = state.pos - state.box / 2.0
+        rgba = speed_colors(state.vel, colormap)
+        out = splat_particles(centered, rgba, radius, cam, width, height,
+                              stamp)
+        return out.image, out.depth, state.pos, state.vel
 
     return frame_step

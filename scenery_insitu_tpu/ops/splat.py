@@ -1,0 +1,131 @@
+"""Particle rendering: sphere-impostor splatting (SURVEY.md §7 step 8).
+
+The reference renders particles as scenery ``Sphere`` nodes, one mesh per
+particle, recreated/moved by a 5 ms fixed-rate update thread
+(reference InVisRenderer.kt:119-209). On TPU the whole pass is one
+vectorized scatter program instead of a scene graph:
+
+  project N particles -> per-particle S×S pixel stamps -> z-buffer
+  scatter-min -> winner-takes-pixel color scatter
+
+Spheres are shaded as impostors (per-pixel depth offset + headlight
+Lambert), so a particle occludes correctly against other particles both
+within a rank and across ranks (sort-first depth-min composite,
+ops.composite.composite_depth_min ≅ Head.kt:98-134).
+
+Depths are the eye-space view depth (distance along the camera forward
+axis), matching the plain-image raycaster's depth output so particle and
+volume images can be composited against each other.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.core.camera import (Camera, projection_matrix,
+                                            view_matrix)
+from scenery_insitu_tpu.core.transfer import colormap_lut
+
+
+class SplatOutput(NamedTuple):
+    image: jnp.ndarray   # f32[4, H, W] premultiplied RGBA
+    depth: jnp.ndarray   # f32[H, W] view depth; +inf where empty
+
+
+def speed_colors(vel: jnp.ndarray, colormap: str = "jet",
+                 alpha: float = 1.0, mean: Optional[jnp.ndarray] = None,
+                 std: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Color particles by sigmoid-normalized speed (≅ the reference's
+    speed-statistics sigmoid scale, InVisRenderer.kt:166-185: speeds are
+    standardized against the population mean/std, squashed through a
+    sigmoid, and used as the colormap coordinate). -> f32[N, 4] straight
+    (non-premultiplied) RGBA.
+
+    mean/std override the population statistics — distributed callers pass
+    globally psum-reduced values so coloring matches a single-device run."""
+    speed = jnp.linalg.norm(vel, axis=-1)
+    mean = jnp.mean(speed) if mean is None else mean
+    std = jnp.maximum(jnp.std(speed) if std is None else std, 1e-8)
+    u = 1.0 / (1.0 + jnp.exp(-(speed - mean) / std))
+    lut = jnp.asarray(colormap_lut(colormap))
+    n = lut.shape[0]
+    x = jnp.clip(u, 0.0, 1.0) * (n - 1)
+    i0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, n - 2)
+    frac = (x - i0)[..., None]
+    rgb = lut[i0] * (1 - frac) + lut[i0 + 1] * frac
+    return jnp.concatenate([rgb, jnp.full_like(rgb[..., :1], alpha)], axis=-1)
+
+
+def splat_particles(pos: jnp.ndarray, rgba: jnp.ndarray, radius,
+                    cam: Camera, width: int, height: int,
+                    stamp: int = 9, ambient: float = 0.25,
+                    radii: Optional[jnp.ndarray] = None) -> SplatOutput:
+    """Render particles as lit opaque spheres.
+
+    pos f32[N, 3] world positions; rgba f32[N, 4] straight colors;
+    ``radius`` scalar world-space sphere radius (or per-particle via
+    ``radii`` f32[N]); ``stamp`` static odd stamp side in pixels — the
+    on-screen radius is clamped to ``stamp // 2`` px, so pick stamp to fit
+    the nearest particles.
+    """
+    n = pos.shape[0]
+    view = view_matrix(cam)
+    proj = projection_matrix(cam, width, height)
+    r_world = jnp.broadcast_to(jnp.asarray(radius, jnp.float32), (n,)) \
+        if radii is None else radii
+
+    p_eye = pos @ view[:3, :3].T + view[:3, 3]             # [N, 3]
+    z = -p_eye[:, 2]                                        # view depth, >0 in front
+    clip = p_eye @ proj[:3, :3].T + proj[:3, 3]
+    w_clip = -p_eye[:, 2]                                   # proj[3] = (0,0,-1,0)
+    ndc = clip[:, :2] / jnp.where(w_clip == 0.0, 1e-12, w_clip)[:, None]
+    px = (ndc[:, 0] + 1.0) * 0.5 * width - 0.5
+    py = (1.0 - ndc[:, 1]) * 0.5 * height - 0.5
+    r_px = r_world * proj[1, 1] * (height * 0.5) / jnp.maximum(z, 1e-6)
+    r_px = jnp.minimum(r_px, stamp // 2)
+    visible = (z > cam.near) & (z < cam.far) & (r_px > 0.05)
+
+    # S×S stamp around each particle's center pixel
+    half = stamp // 2
+    offs = jnp.arange(-half, half + 1, dtype=jnp.float32)
+    oy, ox = jnp.meshgrid(offs, offs, indexing="ij")
+    ox = ox.reshape(-1)                                     # [S²]
+    oy = oy.reshape(-1)
+    cx = jnp.round(px)[:, None] + ox[None]                  # [N, S²]
+    cy = jnp.round(py)[:, None] + oy[None]
+    dx = cx - px[:, None]
+    dy = cy - py[:, None]
+    d2 = dx * dx + dy * dy
+    covered = d2 <= r_px[:, None] ** 2
+
+    # impostor depth offset + normal: the pixel samples the sphere surface
+    frac2 = jnp.clip(d2 / jnp.maximum(r_px[:, None] ** 2, 1e-12), 0.0, 1.0)
+    nz = jnp.sqrt(1.0 - frac2)                              # [N, S²]
+    depth = z[:, None] - nz * r_world[:, None]
+    shade = ambient + (1.0 - ambient) * nz
+    a = rgba[:, 3:4]
+    prgb = rgba[:, :3][:, None, :] * (shade * a)[:, :, None]  # [N, S², 3]
+
+    in_bounds = (cx >= 0) & (cx < width) & (cy >= 0) & (cy < height)
+    ok = covered & in_bounds & visible[:, None]
+    lin = (cy.astype(jnp.int32) * width + cx.astype(jnp.int32)).reshape(-1)
+    lin = jnp.where(ok.reshape(-1), lin, height * width)    # out-of-range -> drop
+    d_flat = depth.reshape(-1)
+
+    zbuf = jnp.full((height * width,), jnp.inf, jnp.float32)
+    zbuf = zbuf.at[lin].min(d_flat, mode="drop")
+
+    # winner-takes-pixel: only the fragment whose depth equals the z-buffer
+    # writes color (ties between coincident fragments resolve arbitrarily)
+    won = jnp.concatenate([zbuf, jnp.array([jnp.inf])])[lin] == d_flat
+    lin_w = jnp.where(won, lin, height * width)
+    img = jnp.zeros((height * width, 4), jnp.float32)
+    frag = jnp.concatenate(
+        [prgb.reshape(-1, 3),
+         jnp.broadcast_to(a, depth.shape).reshape(-1, 1)], axis=-1)
+    img = img.at[lin_w].set(frag, mode="drop")
+
+    return SplatOutput(jnp.moveaxis(img.reshape(height, width, 4), -1, 0),
+                       zbuf.reshape(height, width))

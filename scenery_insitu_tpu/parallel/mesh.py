@@ -43,14 +43,6 @@ def volume_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis_name, None, None))
 
 
-def width_sharding(mesh: Mesh, rank: int,
-                   axis_name: str = DEFAULT_AXIS) -> NamedSharding:
-    """Shard an array of the given rank along its trailing (W) axis — the
-    sort-last output layout where each device owns W/commSize columns
-    (≅ DistributedVolumes.kt:860-861)."""
-    return NamedSharding(mesh, P(*([None] * (rank - 1)), axis_name))
-
-
 def halo_exchange_z(local: jnp.ndarray, axis_name: str = DEFAULT_AXIS
                     ) -> jnp.ndarray:
     """Pad a z-sharded block f32[Dn, H, W] with one neighbor slice on each

@@ -13,7 +13,8 @@ reference hand-built), feeds sinks, and keeps the per-phase timer taxonomy
 
 Runs standalone with the built-in simulations — fixing the reference's
 "cannot be used standalone" limitation (README.md:16) — or driven
-externally through the operator boundary (runtime.api).
+externally by supplying a custom sim adapter (anything with
+``advance(n)`` + ``.field``, see VolumeSimAdapter).
 """
 
 from __future__ import annotations
@@ -42,7 +43,8 @@ Sink = Callable[[int, dict], None]
 
 class VolumeSimAdapter:
     """Uniform facade over the built-in volume sims (kind -> state/advance/
-    field). Particle sims go through models.particle_pipeline instead."""
+    field). Particle sims go through models.pipelines.lj_particle_frame_step
+    instead."""
 
     def __init__(self, cfg: FrameworkConfig, seed: int = 0):
         kind = cfg.sim.kind

@@ -6,55 +6,53 @@ CSV ``avg;min;max;stddev;n`` harness, VolumeFromFileExample.kt:777-794).
 Also emits the machine-greppable per-iteration markers the reference's
 compositing benchmark greps for (``#COMP:rank:iter:sec#`` style,
 VDICompositingTest.kt:301,397-398).
+
+Stats are running aggregates (n, sum, sumsq, min, max) — O(1) memory over
+arbitrarily long campaigns.
 """
 
 from __future__ import annotations
 
+import math
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 
 class PhaseStats:
-    __slots__ = ("values",)
+    __slots__ = ("n", "total", "sumsq", "vmin", "vmax")
 
     def __init__(self):
-        self.values: List[float] = []
+        self.n = 0
+        self.total = 0.0
+        self.sumsq = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
 
     def add(self, seconds: float) -> None:
-        self.values.append(seconds)
-
-    @property
-    def n(self) -> int:
-        return len(self.values)
-
-    @property
-    def total(self) -> float:
-        return sum(self.values)
+        self.n += 1
+        self.total += seconds
+        self.sumsq += seconds * seconds
+        self.vmin = min(self.vmin, seconds)
+        self.vmax = max(self.vmax, seconds)
 
     @property
     def avg(self) -> float:
-        return self.total / self.n if self.values else 0.0
-
-    @property
-    def vmin(self) -> float:
-        return min(self.values) if self.values else 0.0
-
-    @property
-    def vmax(self) -> float:
-        return max(self.values) if self.values else 0.0
+        return self.total / self.n if self.n else 0.0
 
     @property
     def stddev(self) -> float:
-        if len(self.values) < 2:
+        if self.n < 2:
             return 0.0
-        m = self.avg
-        return (sum((v - m) ** 2 for v in self.values) / (self.n - 1)) ** 0.5
+        var = (self.sumsq - self.total * self.total / self.n) / (self.n - 1)
+        return math.sqrt(max(var, 0.0))
 
     def csv(self) -> str:
         """`avg;min;max;stddev;n` — the reference's fps-CSV row format."""
-        return (f"{self.avg:.6f};{self.vmin:.6f};{self.vmax:.6f};"
+        vmin = 0.0 if self.n == 0 else self.vmin
+        vmax = 0.0 if self.n == 0 else self.vmax
+        return (f"{self.avg:.6f};{vmin:.6f};{vmax:.6f};"
                 f"{self.stddev:.6f};{self.n}")
 
 
@@ -64,6 +62,9 @@ class Timers:
     >>> t = Timers(window=100, log=print)
     >>> with t.phase("generate"): ...
     >>> t.frame_done()       # dumps stats every `window` frames
+
+    ``frame_done`` also records the wall time between consecutive calls as
+    the implicit "frame" phase, so ``fps()`` reports end-to-end frame rate.
     """
 
     def __init__(self, window: int = 100, log=None, rank: int = 0):
@@ -73,6 +74,7 @@ class Timers:
         self.stats: Dict[str, PhaseStats] = defaultdict(PhaseStats)
         self.window_stats: Dict[str, PhaseStats] = defaultdict(PhaseStats)
         self.frames = 0
+        self._last_frame_t: Optional[float] = None
 
     @contextmanager
     def phase(self, name: str):
@@ -93,6 +95,10 @@ class Timers:
         self.log(f"#{tag}:{self.rank}:{iteration}:{seconds:.6f}#")
 
     def frame_done(self) -> None:
+        now = time.perf_counter()
+        if self._last_frame_t is not None:
+            self.record("frame", now - self._last_frame_t)
+        self._last_frame_t = now
         self.frames += 1
         if self.frames % self.window == 0:
             self.dump_window()

@@ -127,9 +127,11 @@ def advect_semilagrangian(u: jnp.ndarray, dt: jnp.ndarray) -> jnp.ndarray:
     pos = jnp.stack([bx, by, bz], axis=-1)
 
     def samp(f):
-        # pad one wrap layer so trilinear interp is periodic
-        fp = jnp.pad(f, ((0, 1), (0, 1), (0, 1)), mode="wrap")
-        return sample_trilinear(fp, pos)
+        # pad one wrap layer on BOTH faces (and shift coords by +1) so the
+        # clamped trilinear sampler interpolates periodically across the low
+        # boundary too — positions in [0, 0.5) must blend f[0] with f[n-1]
+        fp = jnp.pad(f, ((1, 1), (1, 1), (1, 1)), mode="wrap")
+        return sample_trilinear(fp, pos + 1.0)
 
     return jnp.stack([samp(u[0]), samp(u[1]), samp(u[2])])
 

@@ -1,0 +1,135 @@
+"""Particle splatting + distributed sort-first compositing tests
+(SURVEY.md §7 step 8; ≅ reference InVisRenderer/Head particle path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.ops.splat import speed_colors, splat_particles
+from scenery_insitu_tpu.parallel.mesh import make_mesh
+from scenery_insitu_tpu.parallel.particles import (distributed_particle_step,
+                                                   shard_particles)
+
+W, H = 64, 48
+
+
+def _cam():
+    return Camera.create((0.0, 0.0, 5.0), target=(0.0, 0.0, 0.0),
+                         fov_y_deg=50.0, near=0.5, far=50.0)
+
+
+class TestSplat:
+    def test_center_particle_lands_center_pixel(self):
+        pos = jnp.array([[0.0, 0.0, 0.0]])
+        rgba = jnp.array([[1.0, 0.0, 0.0, 1.0]])
+        out = splat_particles(pos, rgba, 0.3, _cam(), W, H, stamp=11)
+        img = np.asarray(out.image)
+        dep = np.asarray(out.depth)
+        cy, cx = H // 2, W // 2
+        assert img[3, cy, cx] == 1.0          # opaque at center
+        assert img[0, cy, cx] > 0.0           # red
+        assert img[1, cy, cx] == 0.0
+        # impostor depth at sphere front ≈ distance - radius
+        assert dep[cy, cx] == pytest.approx(5.0 - 0.3, abs=0.05)
+        # empty background stays transparent with +inf depth
+        assert img[3, 0, 0] == 0.0
+        assert np.isinf(dep[0, 0])
+
+    def test_nearer_particle_wins(self):
+        pos = jnp.array([[0.0, 0.0, 0.0], [0.0, 0.0, 1.0]])  # 2nd is nearer
+        rgba = jnp.array([[1.0, 0.0, 0.0, 1.0], [0.0, 1.0, 0.0, 1.0]])
+        out = splat_particles(pos, rgba, 0.3, _cam(), W, H, stamp=11)
+        img = np.asarray(out.image)
+        cy, cx = H // 2, W // 2
+        assert img[1, cy, cx] > 0.0 and img[0, cy, cx] == 0.0
+
+    def test_behind_camera_culled(self):
+        pos = jnp.array([[0.0, 0.0, 10.0]])   # behind the eye at z=5
+        rgba = jnp.ones((1, 4))
+        out = splat_particles(pos, rgba, 0.3, _cam(), W, H)
+        assert np.asarray(out.image).max() == 0.0
+
+    def test_shading_brightest_at_center(self):
+        pos = jnp.array([[0.0, 0.0, 0.0]])
+        rgba = jnp.array([[1.0, 1.0, 1.0, 1.0]])
+        out = splat_particles(pos, rgba, 0.5, _cam(), W, H, stamp=15)
+        img = np.asarray(out.image)
+        cy, cx = H // 2, W // 2
+        covered = img[3] > 0
+        assert covered.sum() > 4
+        assert img[0, cy, cx] == img[0][covered].max()
+        # rim is dimmer than center (impostor normal shading)
+        assert img[0][covered].min() < img[0, cy, cx] * 0.8
+
+    def test_jit_compatible(self):
+        f = jax.jit(lambda p, c: splat_particles(p, c, 0.2, _cam(), W, H))
+        pos = jax.random.uniform(jax.random.PRNGKey(0), (50, 3), minval=-1,
+                                 maxval=1)
+        out = f(pos, jnp.ones((50, 4)))
+        assert out.image.shape == (4, H, W)
+        assert np.isfinite(np.asarray(out.image)).all()
+
+
+class TestSpeedColors:
+    def test_monotone_in_speed(self):
+        vel = jnp.array([[0.1, 0, 0], [1.0, 0, 0], [3.0, 0, 0]])
+        rgba = np.asarray(speed_colors(vel, "grays"))
+        assert rgba.shape == (3, 4)
+        # grays colormap: faster -> brighter
+        assert rgba[0, 0] < rgba[1, 0] < rgba[2, 0]
+        assert (rgba[:, 3] == 1.0).all()
+
+    def test_explicit_stats_match_population(self):
+        key = jax.random.PRNGKey(1)
+        vel = jax.random.normal(key, (256, 3))
+        speed = jnp.linalg.norm(vel, axis=-1)
+        a = speed_colors(vel, "jet")
+        b = speed_colors(vel, "jet", mean=jnp.mean(speed),
+                         std=jnp.std(speed))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+class TestDistributedParticles:
+    def test_matches_single_device(self):
+        n_dev = jax.device_count()
+        mesh = make_mesh(n_dev)
+        n = 64 * n_dev
+        key = jax.random.PRNGKey(2)
+        k1, k2 = jax.random.split(key)
+        pos = jax.random.uniform(k1, (n, 3), minval=-1.2, maxval=1.2)
+        vel = jax.random.normal(k2, (n, 3))
+
+        cam = _cam()
+        step = distributed_particle_step(mesh, W, H, radius=0.15, stamp=9)
+        out = step(shard_particles(pos, mesh), shard_particles(vel, mesh),
+                   cam)
+
+        rgba = speed_colors(vel, "jet")
+        ref = splat_particles(pos, rgba, 0.15, cam, W, H, stamp=9)
+
+        img = np.asarray(out.image)
+        rimg = np.asarray(ref.image)
+        # depth buffers must agree exactly (min over the same fragment set)
+        np.testing.assert_allclose(np.asarray(out.depth),
+                                   np.asarray(ref.depth), atol=1e-6)
+        # colors agree except where equal-depth ties resolve differently
+        agree = np.isclose(img, rimg, atol=1e-5).all(axis=0)
+        assert agree.mean() > 0.999
+
+
+class TestParticlePipeline:
+    def test_lj_frame_step_jits_and_moves(self):
+        from scenery_insitu_tpu.models.pipelines import lj_particle_frame_step
+        from scenery_insitu_tpu.sim import particles as pt
+
+        state, params, spec = pt.lj_init(128, density=0.4)
+        step = jax.jit(lj_particle_frame_step(
+            W, H, params=params, spec=spec, sim_steps=2, radius=0.4))
+        eye = jnp.array([0.0, 0.0, float(state.box) * 1.6], jnp.float32)
+        img, dep, pos, vel = step(state.pos, state.vel, state.box, eye)
+        assert img.shape == (4, H, W)
+        assert np.isfinite(np.asarray(img)).all()
+        assert np.asarray(img)[3].max() > 0.0            # something visible
+        assert not np.allclose(np.asarray(pos), np.asarray(state.pos))

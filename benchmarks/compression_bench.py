@@ -1,0 +1,82 @@
+"""VDI compression benchmark (≅ reference VDICompressionBenchmarks.kt:
+LZ4 / Snappy / LZMA / Gzip over stored VDI color+depth buffers with verify
++ timed iterations, :226-309). Codecs here are the ones this environment
+ships: zstd (the fast-codec role), zlib, lzma.
+
+Usage: python benchmarks/compression_bench.py [--size 720p] [--k 16]
+       [--iters 20] [--grid 64]
+Prints one row per codec/level: ratio, compress/decompress throughput,
+round-trip verification.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def make_vdi(width: int, height: int, k: int, grid: int):
+    import jax.numpy as jnp
+
+    from scenery_insitu_tpu.config import VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import procedural_volume
+    from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+
+    vol = procedural_volume(grid, kind="blobs", seed=1)
+    cam = Camera.create((0.2, 0.6, 3.2), fov_y_deg=50.0, near=0.3, far=20.0)
+    vdi, _ = generate_vdi(vol, for_dataset("procedural"), cam, width, height,
+                          VDIConfig(max_supersegments=k, adaptive_iters=3),
+                          max_steps=128)
+    return np.asarray(vdi.color), np.asarray(vdi.depth)
+
+
+def bench_codec(name: str, level: int, payloads, iters: int):
+    from scenery_insitu_tpu.io.vdi_io import compress, decompress
+
+    raw = sum(p.nbytes for p in payloads)
+    blobs = [compress(p.tobytes(), name, level) for p in payloads]
+    for p, b in zip(payloads, blobs):                      # verify
+        back = np.frombuffer(decompress(b, name), p.dtype).reshape(p.shape)
+        assert np.array_equal(back, p), f"{name} round-trip mismatch"
+    comp = sum(len(b) for b in blobs)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for p in payloads:
+            compress(p.tobytes(), name, level)
+    t_c = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for b in blobs:
+            decompress(b, name)
+    t_d = (time.perf_counter() - t0) / iters
+
+    mb = raw / 1e6
+    print(f"{name:>5} lvl {level:>2}: ratio {raw / comp:6.2f}x  "
+          f"compress {mb / t_c:8.1f} MB/s  decompress {mb / t_d:8.1f} MB/s  "
+          f"({raw} -> {comp} bytes)  verified")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=640)
+    ap.add_argument("--height", type=int, default=360)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--grid", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    color, depth = make_vdi(args.width, args.height, args.k, args.grid)
+    print(f"VDI {args.width}x{args.height} K={args.k}: color {color.nbytes} B"
+          f" + depth {depth.nbytes} B")
+    for name, level in [("zstd", 1), ("zstd", 3), ("zstd", 9),
+                        ("zlib", 1), ("zlib", 6), ("lzma", 0), ("none", 0)]:
+        bench_codec(name, level, [color, depth], args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -113,7 +113,7 @@ class StreamConfig:
     steer_bind: str = "tcp://*:6655"
     steer_connect: str = "tcp://localhost:6655"
     video_port: int = 3337
-    compress: str = "lz4"           # lz4 | zlib | none
+    compress: str = "zstd"          # zstd | zlib | lzma | none (see io.vdi_io)
 
 
 @dataclass(frozen=True)

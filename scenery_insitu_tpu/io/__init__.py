@@ -1,0 +1,3 @@
+from scenery_insitu_tpu.io.vdi_io import (  # noqa: F401
+    compress, decompress, load_vdi, pack_vdi_segments, save_vdi,
+    unpack_vdi_segments)

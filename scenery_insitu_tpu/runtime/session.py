@@ -148,6 +148,24 @@ class InSituSession:
         return payload
 
 
+def vdi_sink(directory: str, dataset: str = "session", every: int = 1,
+             codec: str = "zstd") -> Sink:
+    """Dump composited VDIs as .npz artifacts — the render-product
+    checkpoint stream offline renderers replay (≅ saveFinal VDIDataIO +
+    buffer dumps, DistributedVolumes.kt:846-851, 910-915)."""
+    from scenery_insitu_tpu.core.vdi import VDI as _VDI
+    from scenery_insitu_tpu.io.vdi_io import dump_path, save_vdi
+
+    def sink(index: int, payload: dict) -> None:
+        if index % every or "vdi_color" not in payload:
+            return
+        save_vdi(dump_path(directory, dataset, index, "vdi"),
+                 _VDI(payload["vdi_color"], payload["vdi_depth"]),
+                 codec=codec)
+
+    return sink
+
+
 def png_sink(directory: str, gamma: float = 2.2, every: int = 1) -> Sink:
     """Dump frames/VDI same-view decodes as PNGs (≅ the reference's
     screenshot + SystemHelpers.dumpToFile outputs)."""

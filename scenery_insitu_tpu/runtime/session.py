@@ -87,6 +87,9 @@ class InSituSession:
         self.sinks: List[Sink] = list(sinks)
         self.frame_index = 0
         self.orbit_rate = 0.0  # radians/frame camera sweep (benchmark mode)
+        self.steering = None   # optional streaming.SteeringEndpoint
+        self.on_steer: List[Callable[[dict], None]] = []  # non-camera msgs
+        self._pending_meta = {}  # frame index -> VDIMetadata at dispatch
 
         r = self.cfg.render
         if self.cfg.runtime.generate_vdis:
@@ -109,11 +112,23 @@ class InSituSession:
 
     def render_frame(self):
         """Advance the sim and dispatch one render step (device arrays)."""
+        if self.steering is not None:
+            from scenery_insitu_tpu.runtime.streaming import apply_steering
+            with self.timers.phase("steer"):
+                for msg in self.steering.drain():
+                    self.camera, other = apply_steering(self.camera, msg)
+                    for kind_msg in other.values():
+                        for cb in self.on_steer:
+                            cb(kind_msg)
         with self.timers.phase("sim"):
             self.sim.advance(self.cfg.sim.steps_per_frame)
         with self.timers.phase("dispatch"):
             field = shard_volume(self.sim.field, self.mesh)
             out = self._step(field, self._origin, self._spacing, self.camera)
+        # metadata snapshot BEFORE the camera advances (fetch is pipelined
+        # one frame behind, so it must not see the next frame's pose)
+        self._pending_meta[self.frame_index] = \
+            self.frame_metadata(self.frame_index)
         if self.orbit_rate:
             self.camera = orbit(self.camera, jnp.float32(self.orbit_rate))
         self.frame_index += 1
@@ -142,10 +157,29 @@ class InSituSession:
             else:
                 payload = {"image": np.asarray(out)}
             payload["frame"] = index
+            payload["meta"] = self._pending_meta.pop(index,
+                                                     self.frame_metadata(index))
         with self.timers.phase("sinks"):
             for s in self.sinks:
                 s(index, payload)
         return payload
+
+    def frame_metadata(self, index: int):
+        """VDIMetadata for the current camera/volume placement (≅ the
+        per-frame VDIData the reference builds, DistributedVolumes.kt:
+        706-716). NOTE: built from the CURRENT camera — call before the
+        camera advances for exact correspondence."""
+        from scenery_insitu_tpu.core.camera import (projection_matrix,
+                                                    view_matrix)
+        from scenery_insitu_tpu.core.vdi import VDIMetadata
+        r = self.cfg.render
+        shape = np.asarray(self.sim.field.shape)
+        return VDIMetadata.create(
+            projection=projection_matrix(self.camera, r.width, r.height),
+            view=view_matrix(self.camera),
+            volume_dims=np.asarray(shape[::-1], np.float32),   # (x, y, z)
+            window_dims=(r.width, r.height),
+            nw=float(self._spacing[0]), index=index)
 
 
 def vdi_sink(directory: str, dataset: str = "session", every: int = 1,

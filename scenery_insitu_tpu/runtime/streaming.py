@@ -1,0 +1,295 @@
+"""Streaming + steering (SURVEY.md §7 step 10b, layer L7).
+
+≅ the reference's side channels:
+- ZMQ PUB of VDI frames ``[size-ascii | metadata | color | depth]`` with
+  LZ4-compressed buffers (VolumeFromFileExample.kt:996-1037) →
+  ``VDIPublisher``/``VDISubscriber`` multipart messages
+  ``[msgpack header, color blob, depth blob]`` with io.vdi_io codecs.
+- msgpack camera/steering messages applied inside the render loop,
+  dispatched by payload size (DistributedVolumeRenderer.kt:747-774;
+  Head.adjustCamera, Head.kt:137-161) → typed msgpack dicts with a
+  ``"type"`` field, applied by ``apply_steering``.
+- the headless InSituMaster relay that rebroadcasts viewer messages to all
+  render ranks (InSituMaster.kt:14-45) → ``SteeringRelay``.
+- H264/UDP video stream + movie writer (DistributedVolumeRenderer.kt:
+  275-291) → ``video_sink`` (cv2 VideoWriter; this image has no ffmpeg/
+  libx264, so the codec is what cv2 ships — the transport role, not the
+  exact bitstream).
+
+Everything degrades gracefully: constructing any endpoint raises
+ImportError only when pyzmq is genuinely missing, and the session works
+fully without streaming attached.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
+from scenery_insitu_tpu.io.vdi_io import compress, decompress
+
+_META_FIELDS = ("projection", "view", "model", "volume_dims", "window_dims",
+                "nw", "index")
+
+
+def _msgpack():
+    import msgpack
+    return msgpack
+
+
+def _zmq():
+    import zmq
+    return zmq
+
+
+# --------------------------------------------------------------- VDI stream
+
+class VDIPublisher:
+    """PUB endpoint streaming (metadata, color, depth) per frame."""
+
+    def __init__(self, bind: str = "tcp://*:6655", codec: str = "zstd",
+                 level: int = -1):
+        zmq = _zmq()
+        self.codec = codec
+        self.level = level
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PUB)
+        if bind.endswith(":0"):                      # ephemeral port for tests
+            port = self.sock.bind_to_random_port(bind[:-2])
+            self.endpoint = f"{bind[:-2].replace('*', '127.0.0.1')}:{port}"
+        else:
+            self.sock.bind(bind)
+            self.endpoint = bind.replace("*", "127.0.0.1")
+
+    def publish(self, vdi: VDI, meta: VDIMetadata) -> int:
+        """Send one frame; returns wire bytes (≅ the compressed publish loop,
+        VolumeFromFileExample.kt:974-1037)."""
+        color = np.ascontiguousarray(np.asarray(vdi.color))
+        depth = np.ascontiguousarray(np.asarray(vdi.depth))
+        cblob = compress(color.tobytes(), self.codec, self.level)
+        dblob = compress(depth.tobytes(), self.codec, self.level)
+        header = _msgpack().packb({
+            "codec": self.codec,
+            "color_shape": list(color.shape),
+            "depth_shape": list(depth.shape),
+            "meta": {f: np.asarray(getattr(meta, f)).tolist()
+                     for f in _META_FIELDS},
+        })
+        self.sock.send_multipart([header, cblob, dblob])
+        return len(header) + len(cblob) + len(dblob)
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class VDISubscriber:
+    """SUB endpoint for the streamed-VDI client (novel-view rendering of
+    received VDIs via ops.vdi_render)."""
+
+    def __init__(self, connect: str = "tcp://localhost:6655"):
+        zmq = _zmq()
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.SUB)
+        self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        self.sock.connect(connect)
+
+    def receive(self, timeout_ms: Optional[int] = None
+                ) -> Optional[Tuple[VDI, VDIMetadata]]:
+        zmq = _zmq()
+        if timeout_ms is not None:
+            if not self.sock.poll(timeout_ms):
+                return None
+        header, cblob, dblob = self.sock.recv_multipart()
+        h = _msgpack().unpackb(header)
+        color = np.frombuffer(decompress(cblob, h["codec"]), np.float32) \
+            .reshape(h["color_shape"])
+        depth = np.frombuffer(decompress(dblob, h["codec"]), np.float32) \
+            .reshape(h["depth_shape"])
+        m = h["meta"]
+        meta = VDIMetadata.create(
+            projection=np.asarray(m["projection"], np.float32),
+            view=np.asarray(m["view"], np.float32),
+            model=np.asarray(m["model"], np.float32),
+            volume_dims=np.asarray(m["volume_dims"], np.float32),
+            window_dims=np.asarray(m["window_dims"], np.int32),
+            nw=float(np.asarray(m["nw"])), index=int(np.asarray(m["index"])))
+        return VDI(color, depth), meta
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+# ----------------------------------------------------------------- steering
+
+def make_camera_message(cam: Camera) -> dict:
+    """Viewer -> renderer camera pose (≅ the msgpack camera payload,
+    VolumeFromFileExample.kt:907-918)."""
+    return {"type": "camera",
+            "eye": np.asarray(cam.eye).tolist(),
+            "target": np.asarray(cam.target).tolist(),
+            "up": np.asarray(cam.up).tolist(),
+            "fov_y": float(np.asarray(cam.fov_y))}
+
+
+def apply_steering(cam: Camera, msg: dict) -> Tuple[Camera, dict]:
+    """Apply one steering message; returns (camera, side_effects). Unknown
+    types pass through in side_effects (≅ updateVis dispatch,
+    DistributedVolumeRenderer.kt:747-774 — there by payload size, here by
+    the explicit type tag)."""
+    import jax.numpy as jnp
+
+    kind = msg.get("type")
+    if kind == "camera":
+        cam = cam._replace(
+            eye=jnp.asarray(msg["eye"], jnp.float32),
+            target=jnp.asarray(msg.get("target", np.asarray(cam.target)),
+                               jnp.float32),
+            up=jnp.asarray(msg.get("up", np.asarray(cam.up)), jnp.float32))
+        if "fov_y" in msg:
+            cam = cam._replace(fov_y=jnp.float32(msg["fov_y"]))
+        return cam, {}
+    return cam, {kind: msg}
+
+
+class SteeringEndpoint:
+    """Renderer-side SUB socket draining steering messages each frame."""
+
+    def __init__(self, connect_or_bind: str = "tcp://*:6656", bind: bool = True):
+        zmq = _zmq()
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.SUB)
+        self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        if bind and connect_or_bind.endswith(":0"):
+            port = self.sock.bind_to_random_port(connect_or_bind[:-2])
+            self.endpoint = (f"{connect_or_bind[:-2].replace('*', '127.0.0.1')}"
+                             f":{port}")
+        elif bind:
+            self.sock.bind(connect_or_bind)
+            self.endpoint = connect_or_bind.replace("*", "127.0.0.1")
+        else:
+            self.sock.connect(connect_or_bind)
+            self.endpoint = connect_or_bind
+
+    def drain(self) -> Iterator[dict]:
+        zmq = _zmq()
+        while True:
+            try:
+                yield _msgpack().unpackb(self.sock.recv(zmq.NOBLOCK))
+            except zmq.Again:
+                return
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class SteeringPublisher:
+    """Viewer-side PUB socket (≅ the ZMQ publisher feeding InSituMaster)."""
+
+    def __init__(self, connect: str):
+        zmq = _zmq()
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PUB)
+        self.sock.connect(connect)
+
+    def send(self, msg: dict) -> None:
+        self.sock.send(_msgpack().packb(msg))
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class SteeringRelay:
+    """Headless relay: SUB upstream, PUB to every render endpoint
+    (≅ InSituMaster forwarding payloads to all ranks via MPI broadcast,
+    InSituMaster.kt:14-45 — here the fan-out is a PUB socket)."""
+
+    def __init__(self, upstream_bind: str = "tcp://*:6655",
+                 downstream_bind: str = "tcp://*:6656"):
+        zmq = _zmq()
+        self.ctx = zmq.Context.instance()
+        self.sub = self.ctx.socket(zmq.SUB)
+        self.sub.setsockopt(zmq.SUBSCRIBE, b"")
+        self.pub = self.ctx.socket(zmq.PUB)
+        for sock, ep in ((self.sub, upstream_bind), (self.pub, downstream_bind)):
+            if ep.endswith(":0"):
+                port = sock.bind_to_random_port(ep[:-2])
+                ep = f"{ep[:-2].replace('*', '127.0.0.1')}:{port}"
+            else:
+                sock.bind(ep)
+                ep = ep.replace("*", "127.0.0.1")
+            if sock is self.sub:
+                self.upstream = ep
+            else:
+                self.downstream = ep
+
+    def pump(self, max_messages: int = 64) -> int:
+        """Forward pending messages; returns count."""
+        zmq = _zmq()
+        n = 0
+        for _ in range(max_messages):
+            try:
+                self.pub.send(self.sub.recv(zmq.NOBLOCK))
+                n += 1
+            except zmq.Again:
+                break
+        return n
+
+    def close(self) -> None:
+        self.sub.close(linger=0)
+        self.pub.close(linger=0)
+
+
+def stream_sink(publisher: VDIPublisher) -> Callable[[int, dict], None]:
+    """Session sink that publishes every fetched VDI frame (≅ transmitVDIs
+    mode, VolumeFromFileExample.kt:996-1037). Requires payloads carrying
+    ``meta`` (InSituSession provides it)."""
+    import jax.numpy as jnp
+
+    def sink(index: int, payload: dict) -> None:
+        if "vdi_color" not in payload or "meta" not in payload:
+            return
+        publisher.publish(VDI(jnp.asarray(payload["vdi_color"]),
+                              jnp.asarray(payload["vdi_depth"])),
+                          payload["meta"])
+
+    return sink
+
+
+# -------------------------------------------------------------- video sinks
+
+def video_sink(path: str, fps: float = 30.0, gamma: float = 2.2
+               ) -> Callable[[int, dict], None]:
+    """Movie-writer sink for session image payloads (≅ the reference's
+    VideoEncoder movie file, DistributedVolumeRenderer.kt:285). Lazily opens
+    the writer on the first frame (size unknown until then)."""
+    import cv2
+
+    state = {"writer": None}
+
+    def sink(index: int, payload: dict) -> None:
+        if "image" in payload:
+            img = payload["image"]
+        elif "vdi_color" in payload:
+            import jax.numpy as jnp
+
+            from scenery_insitu_tpu.core.vdi import render_vdi_same_view
+            img = np.asarray(render_vdi_same_view(
+                VDI(jnp.asarray(payload["vdi_color"]),
+                    jnp.asarray(payload["vdi_depth"]))))
+        else:
+            return
+        rgb = np.clip(img[:3], 0.0, 1.0) ** (1.0 / gamma)
+        frame = (np.moveaxis(rgb, 0, -1) * 255).astype(np.uint8)
+        if state["writer"] is None:
+            h, w = frame.shape[:2]
+            state["writer"] = cv2.VideoWriter(
+                path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+        state["writer"].write(frame[:, :, ::-1])          # RGB -> BGR
+
+    sink.release = lambda: (state["writer"].release()
+                            if state["writer"] else None)
+    return sink

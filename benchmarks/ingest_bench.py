@@ -1,0 +1,89 @@
+"""Shared-memory ingest micro-benchmark (≅ the reference's IPC transport
+matrix: sem/heap/sysv/mmap/fifo/tcp × 1 KB–1 GB × 5000 iters,
+src/test/cpp/benchmark/test_params.hpp:21-44, and the C++↔JVM TestConsumer
+harness). Measures the TPU-relevant chain: producer memcpy → shm → consumer
+(zero-copy pin vs copy) → optional device_put to HBM.
+
+Usage: python benchmarks/ingest_bench.py [--iters 200] [--max-mb 64]
+       [--device]
+Prints one row per size: publish, consume(copy), consume(pin), and with
+--device the host→HBM hop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+import uuid
+
+import numpy as np
+
+from scenery_insitu_tpu.ingest.shm import ShmConsumer, ShmProducer
+
+
+def bench_size(nfloats: int, iters: int, device: bool):
+    shape = (nfloats,)
+    ch = f"/sitpu_bench_{uuid.uuid4().hex[:8]}"
+    prod = ShmProducer(ch, shape)
+    cons = ShmConsumer(ch, shape, timeout_ms=2000)
+    frame = np.random.default_rng(0).random(nfloats).astype(np.float32)
+    mb = frame.nbytes / 1e6
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            prod.publish(frame)
+        t_pub = (time.perf_counter() - t0) / iters
+
+        t_copy = t_pin = t_dev = float("nan")
+        # consume with copy
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            prod.publish(frame)
+            cons.latest(timeout_ms=1000)
+        t_copy = (time.perf_counter() - t0) / iters - t_pub
+
+        # consume zero-copy pin/release
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            prod.publish(frame)
+            pinned, _ = cons.latest(timeout_ms=1000, copy=False)
+            cons.release(pinned.slot)
+        t_pin = (time.perf_counter() - t0) / iters - t_pub
+
+        if device:
+            import jax
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                prod.publish(frame)
+                arr, _ = cons.latest(timeout_ms=1000)
+                jax.device_put(arr).block_until_ready()
+            t_dev = (time.perf_counter() - t0) / iters - t_pub
+
+        def mbs(t):
+            return mb / t if t > 0 else float("inf")
+
+        print(f"{frame.nbytes:>12} B: publish {mbs(t_pub):9.0f} MB/s  "
+              f"consume+copy {mbs(t_copy):9.0f} MB/s  "
+              f"consume+pin {mbs(t_pin):9.0f} MB/s"
+              + (f"  +device_put {mbs(t_dev):9.0f} MB/s" if device else ""))
+    finally:
+        cons.close()
+        prod.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--max-mb", type=float, default=64.0)
+    ap.add_argument("--device", action="store_true",
+                    help="include the host->HBM device_put hop")
+    args = ap.parse_args()
+
+    n = 256
+    while n * 4 <= args.max_mb * 1e6:
+        bench_size(n, max(args.iters, 3), args.device)
+        n *= 4
+
+
+if __name__ == "__main__":
+    main()

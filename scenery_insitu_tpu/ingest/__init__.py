@@ -1,0 +1,2 @@
+from scenery_insitu_tpu.ingest.shm import (  # noqa: F401
+    ShmConsumer, ShmProducer, ShmVolumeSource, ensure_built)

@@ -1,0 +1,206 @@
+"""Python bindings for the C++ shared-memory frame transport
+(SURVEY.md §7 step 7 — layer L1, the sim↔renderer operator boundary).
+
+The reference crossed this boundary with SysV shm + JNI
+``NewDirectByteBuffer`` zero-copy handoff (SharedSpheresExample.cpp:54);
+here ctypes maps the C ABI of ``native/shm_transport.cpp`` and the consumer
+exposes each pinned slot as a zero-copy numpy view, which ``device_put``
+then ships host→HBM (the one copy a TPU cannot avoid — SURVEY.md §7 "hard
+parts"; overlap it with compute by dispatching before blocking).
+
+``ShmVolumeSource`` adapts a channel to the session loop's sim-facade
+protocol (``advance(n)`` + ``.field``), so an external C++/OpenFPM-style
+simulation can drive InSituSession exactly like the built-in sims — the
+``addVolume/updateVolume`` operator boundary of the reference
+(DistributedVolumes.kt:147-250) collapses to "publish a frame".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libshm_transport.so")
+DEMO_PRODUCER = os.path.join(_NATIVE_DIR, "build", "demo_producer")
+
+_lib = None
+
+
+def ensure_built(force: bool = False) -> str:
+    """Build the native library on first use (g++ is part of the image)."""
+    if force or not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True)
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(ensure_built())
+    lib.shm_channel_create.restype = ctypes.c_void_p
+    lib.shm_channel_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_uint32]
+    lib.shm_producer_acquire.restype = ctypes.c_void_p
+    lib.shm_producer_acquire.argtypes = [ctypes.c_void_p]
+    lib.shm_producer_publish.restype = ctypes.c_uint64
+    lib.shm_producer_publish.argtypes = [ctypes.c_void_p]
+    lib.shm_channel_frames_dropped.restype = ctypes.c_uint64
+    lib.shm_channel_frames_dropped.argtypes = [ctypes.c_void_p]
+    lib.shm_consumer_open.restype = ctypes.c_void_p
+    lib.shm_consumer_open.argtypes = [ctypes.c_char_p]
+    lib.shm_channel_slot_size.restype = ctypes.c_uint64
+    lib.shm_channel_slot_size.argtypes = [ctypes.c_void_p]
+    lib.shm_channel_nslots.restype = ctypes.c_uint32
+    lib.shm_channel_nslots.argtypes = [ctypes.c_void_p]
+    lib.shm_consumer_latest.restype = ctypes.c_int32
+    lib.shm_consumer_latest.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.POINTER(ctypes.c_void_p),
+                                        ctypes.POINTER(ctypes.c_uint64)]
+    lib.shm_consumer_release.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.shm_channel_close.argtypes = [ctypes.c_void_p]
+    lib.shm_channel_unlink.restype = ctypes.c_int
+    lib.shm_channel_unlink.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+class ShmProducer:
+    """Publish fixed-shape f32 frames (the simulation side; ≅ ShmAllocator's
+    shm_alloc/shm_free cycle, ShmAllocator.cpp:59-151)."""
+
+    def __init__(self, channel: str, shape: Sequence[int], nslots: int = 3):
+        self.lib = _load()
+        self.shape = tuple(shape)
+        self.nbytes = int(np.prod(self.shape)) * 4
+        self.channel = channel
+        self.handle = self.lib.shm_channel_create(
+            channel.encode(), self.nbytes, nslots)
+        if not self.handle:
+            raise OSError(f"could not create shm channel {channel!r}")
+
+    def publish(self, frame: np.ndarray) -> int:
+        """Copy one frame in and publish; returns seq (0 = dropped: every
+        writable slot was pinned by slow readers — the producer never
+        blocks, matching the reference's guarantee)."""
+        frame = np.ascontiguousarray(frame, np.float32)
+        if frame.shape != self.shape:
+            raise ValueError(f"frame shape {frame.shape} != {self.shape}")
+        ptr = self.lib.shm_producer_acquire(self.handle)
+        if not ptr:
+            return 0
+        ctypes.memmove(ptr, frame.ctypes.data, self.nbytes)
+        return self.lib.shm_producer_publish(self.handle)
+
+    @property
+    def frames_dropped(self) -> int:
+        return self.lib.shm_channel_frames_dropped(self.handle)
+
+    def close(self, unlink: bool = True) -> None:
+        if self.handle:
+            self.lib.shm_channel_close(self.handle)
+            self.handle = None
+            if unlink:
+                self.lib.shm_channel_unlink(self.channel.encode())
+
+
+class ShmConsumer:
+    """Receive frames (the renderer side; ≅ ShmBuffer's
+    update_key/attach/detach cycle, ShmBuffer.cpp:29-112)."""
+
+    def __init__(self, channel: str, shape: Sequence[int],
+                 timeout_ms: int = 5000, poll_interval_ms: int = 20):
+        import time
+        self.lib = _load()
+        self.shape = tuple(shape)
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        self.handle = None
+        while time.monotonic() < deadline:         # producer may start later
+            h = self.lib.shm_consumer_open(channel.encode())
+            if h:
+                self.handle = h
+                break
+            time.sleep(poll_interval_ms / 1000.0)
+        if not self.handle:
+            raise TimeoutError(f"shm channel {channel!r} never appeared")
+        slot = self.lib.shm_channel_slot_size(self.handle)
+        want = int(np.prod(self.shape)) * 4
+        if slot != want:
+            self.lib.shm_channel_close(self.handle)
+            raise ValueError(f"channel slot size {slot} != expected {want}")
+
+    def latest(self, timeout_ms: int = -1, copy: bool = True
+               ) -> Optional[Tuple[np.ndarray, int]]:
+        """Newest frame strictly newer than the last seen, or None on
+        timeout. copy=False returns the zero-copy view WITHOUT releasing
+        the slot — call release(slot) (attr ``.slot`` on the array) when
+        done, exactly the reference's detach discipline."""
+        data = ctypes.c_void_p()
+        seq = ctypes.c_uint64()
+        idx = self.lib.shm_consumer_latest(self.handle, timeout_ms,
+                                           ctypes.byref(data),
+                                           ctypes.byref(seq))
+        if idx < 0:
+            return None
+        n = int(np.prod(self.shape))
+        buf = (ctypes.c_float * n).from_address(data.value)
+        view = np.frombuffer(buf, np.float32).reshape(self.shape)
+        if copy:
+            out = view.copy()
+            self.lib.shm_consumer_release(self.handle, idx)
+            return out, seq.value
+
+        class _Pinned(np.ndarray):      # ndarray subclass carrying the slot
+            pass
+
+        pinned = view.view(_Pinned)
+        pinned.flags.writeable = False
+        pinned.slot = idx
+        return pinned, seq.value
+
+    def release(self, slot: int) -> None:
+        self.lib.shm_consumer_release(self.handle, slot)
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.shm_channel_close(self.handle)
+            self.handle = None
+
+
+class ShmVolumeSource:
+    """Session sim-adapter over a shm channel: ``advance(n)`` pulls the
+    newest frame (blocking until one arrives), ``.field`` is the device
+    array. Plugs an EXTERNAL simulation into InSituSession."""
+
+    def __init__(self, channel: str, grid: Sequence[int],
+                 timeout_ms: int = 10000, device_put: bool = True):
+        import jax
+
+        self.kind = "external"
+        self.consumer = ShmConsumer(channel, grid, timeout_ms=timeout_ms)
+        self.timeout_ms = timeout_ms
+        self._device_put = device_put
+        self._jax = jax
+        self._field = None
+
+    def advance(self, n: int) -> None:   # n is meaningless for external sims
+        got = self.consumer.latest(timeout_ms=self.timeout_ms)
+        if got is None:
+            if self._field is None:
+                raise TimeoutError("no frame from external simulation")
+            return                        # keep rendering the last frame
+        frame, _ = got
+        self._field = (self._jax.device_put(frame) if self._device_put
+                       else frame)
+
+    @property
+    def field(self):
+        if self._field is None:
+            self.advance(1)
+        return self._field

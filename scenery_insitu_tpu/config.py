@@ -59,6 +59,9 @@ class CompositeConfig:
     # VDIConfig.threshold/adaptive).
     adaptive: bool = True
     adaptive_iters: int = 6
+    # Merge-fold schedule: "xla" = lax.scan over slots; "pallas" = fused
+    # pixel-tile kernel (ops.pallas_composite); "auto" = pallas on TPU.
+    backend: str = "auto"
 
 
 @dataclass(frozen=True)

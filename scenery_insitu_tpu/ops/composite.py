@@ -63,6 +63,14 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
     else:
         threshold = jnp.zeros((h, w), jnp.float32)
 
+    backend = cfg.backend
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if backend == "pallas":
+        from scenery_insitu_tpu.ops.pallas_composite import resegment_sorted
+        color, depth = resegment_sorted(sc, sd, threshold, k_out, gap_eps)
+        return VDI(color, depth)
+
     def body(st, item):
         c, d = item
         return ss.push(st, k_out, threshold, c, d[0], d[1], gap_eps), None

@@ -131,8 +131,10 @@ def _write(state: SegState, do_write: jnp.ndarray,
            out_color, out_start, out_end):
     kmax = out_color.shape[0]
     slot = jnp.minimum(state.k, kmax - 1)
-    onehot = (jnp.arange(kmax, dtype=jnp.int32).reshape(-1, 1, 1) == slot[None]) \
-        & do_write[None]                                   # [K, H, W]
+    # broadcasted_iota (not arange+reshape): Mosaic can't lower a 1D iota
+    # shape-cast, and this fold also runs inside the Pallas composite kernel
+    slot_ids = jax.lax.broadcasted_iota(jnp.int32, (kmax, 1, 1), 0)
+    onehot = (slot_ids == slot[None]) & do_write[None]     # [K, H, W]
     out_color = jnp.where(onehot[:, None], state.seg_rgba[None], out_color)
     out_start = jnp.where(onehot, state.seg_start[None], out_start)
     out_end = jnp.where(onehot, state.seg_end[None], out_end)

@@ -51,6 +51,27 @@ class VDIConfig:
 
 
 @dataclass(frozen=True)
+class SliceMarchConfig:
+    """MXU slice-march raycaster settings (ops/slicer.py — the TPU-native
+    engine; the gather-path raycaster in ops/raycast.py is the portable
+    reference implementation)."""
+
+    # Render engine: "mxu" = slice march (fast on TPU), "gather" = per-ray
+    # trilinear gathers (reference path), "auto" = mxu on TPU else gather
+    # (resolved by ops.slicer.resolve_engine; consumed by the pipelines'
+    # `engine=` argument and the session loop).
+    engine: str = "auto"
+    # Intermediate grid resolution multiplier over the in-plane voxel count.
+    scale: float = 1.25
+    # Slices folded per scan step (bounds carry round-trips through HBM).
+    chunk: int = 16
+    # Resampling matmul operand dtype: "bf16" (MXU-native) or "f32".
+    matmul_dtype: str = "bf16"
+    # Minimum eye-depth ratio; slices closer to the eye plane are dropped.
+    s_floor: float = 1e-3
+
+
+@dataclass(frozen=True)
 class CompositeConfig:
     """Sort-last VDI compositing (≅ VDICompositor.comp)."""
 
@@ -122,6 +143,7 @@ class StreamConfig:
 @dataclass(frozen=True)
 class FrameworkConfig:
     render: RenderConfig = field(default_factory=RenderConfig)
+    slicer: SliceMarchConfig = field(default_factory=SliceMarchConfig)
     vdi: VDIConfig = field(default_factory=VDIConfig)
     composite: CompositeConfig = field(default_factory=CompositeConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)

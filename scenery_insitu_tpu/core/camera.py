@@ -66,6 +66,20 @@ def perspective(fov_y: jnp.ndarray, aspect: float, near, far) -> jnp.ndarray:
     return proj
 
 
+def frustum(l, r, b, t, n, f) -> jnp.ndarray:
+    """Off-axis (glFrustum-style) OpenGL perspective projection from the
+    near-plane window [l, r] x [b, t]; NDC z in [-1, 1]. All arguments may
+    be traced scalars (the slice-march virtual camera rebuilds its frustum
+    every frame, ops/slicer.py)."""
+    l, r, b, t, n, f = (jnp.asarray(v, jnp.float32) for v in (l, r, b, t, n, f))
+    zero = jnp.zeros_like(n)
+    row0 = jnp.stack([2 * n / (r - l), zero, (r + l) / (r - l), zero])
+    row1 = jnp.stack([zero, 2 * n / (t - b), (t + b) / (t - b), zero])
+    row2 = jnp.stack([zero, zero, (f + n) / (n - f), 2 * f * n / (n - f)])
+    row3 = jnp.stack([zero, zero, -jnp.ones_like(n), zero])
+    return jnp.stack([row0, row1, row2, row3])
+
+
 def view_matrix(cam: Camera) -> jnp.ndarray:
     return look_at(cam.eye, cam.target, cam.up)
 

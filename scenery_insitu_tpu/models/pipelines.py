@@ -26,22 +26,45 @@ def grayscott_vdi_frame_step(width: int, height: int,
                              comp_cfg: Optional[CompositeConfig] = None,
                              tf: Optional[TransferFunction] = None,
                              params: Optional[gs.GrayScottParams] = None,
-                             fov_y_deg: float = 50.0):
+                             fov_y_deg: float = 50.0,
+                             engine: str = "auto",
+                             grid_shape=None, axis_sign=None,
+                             slicer_cfg=None):
     """Single-chip in-situ frame step: Gray-Scott advance → VDI generation
     → composite. Returns ``fn(u, v, eye) -> (color, depth, u, v)``
-    (jittable; the flagship single-device hot path)."""
+    (jittable; the flagship single-device hot path).
+
+    engine="mxu" uses the slice-march raycaster (ops/slicer.py; requires
+    the static ``grid_shape``; ``axis_sign`` pins the march regime —
+    cameras outside that regime need a rebuilt step). The VDI then lives on
+    the virtual axis camera's grid instead of (width, height). "auto"
+    resolves to mxu on TPU, gather elsewhere."""
+    from scenery_insitu_tpu.ops import slicer
+
     tf = tf or for_dataset("gray_scott")
     vdi_cfg = vdi_cfg or VDIConfig(max_supersegments=8, adaptive_iters=2)
     comp_cfg = comp_cfg or CompositeConfig(max_output_supersegments=8,
                                            adaptive_iters=2)
     params = params or gs.GrayScottParams.create()
+    engine = slicer.resolve_engine(engine)
+
+    spec = None
+    if engine == "mxu":
+        if grid_shape is None:
+            raise ValueError("engine='mxu' needs the static grid_shape")
+        spec = slicer.make_spec(
+            Camera.create((0.0, 0.6, 3.0), fov_y_deg=fov_y_deg),
+            tuple(grid_shape), slicer_cfg, axis_sign=axis_sign)
 
     def frame_step(u, v, eye):
         state = gs.multi_step(gs.GrayScott(u, v, params), sim_steps)
         vol = Volume.centered(state.field, extent=2.0)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
-        vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
-                              max_steps=max_steps)
+        if engine == "mxu":
+            vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec, vdi_cfg)
+        else:
+            vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
+                                  max_steps=max_steps)
         out = composite_vdis(vdi.color[None], vdi.depth[None], comp_cfg)
         return out.color, out.depth, state.u, state.v
 

@@ -7,8 +7,11 @@ machine as a ``lax.scan`` over the N*K depth-sorted slots with full-frame
 [H, W] state — every scan iteration round-trips the state through HBM. This
 kernel fuses the whole fold over a (8, 128)-pixel tile held in VMEM: the
 stream axis becomes an in-kernel ``fori_loop`` whose carry lives in
-registers/VMEM, so each slab is read from HBM exactly once and no
-intermediate state ever spills.
+registers/VMEM, so the *write pass* reads each slab from HBM exactly once
+and no intermediate state ever spills. (With ``CompositeConfig.adaptive``
+the preceding threshold search still runs ``adaptive_iters`` counting
+scans through XLA — fusing those into the same tile scheme is the next
+step for this kernel.)
 
 The kernel body calls the very same ``supersegments.push``/``finalize``
 functions the XLA path uses — one implementation of the merge semantics,

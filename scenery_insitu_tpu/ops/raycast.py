@@ -5,8 +5,9 @@ AccumulatePlainImage.comp + ComputeRaycast.comp).
 Pure-JAX implementation: the march is a ``lax.fori_loop`` with a static trip
 count over ``[H, W]``-shaped vectorized steps, so XLA sees one fused
 elementwise+gather body — no per-pixel Python control flow, no dynamic
-shapes. (A Pallas kernel with identical semantics lives in
-``ops/pallas/``; tests assert parity.)
+shapes. The per-step trilinear gathers make this the *portable reference
+path*; the TPU-native engine is the MXU slice march in ``ops/slicer.py``
+(no gathers in the hot loop; tests assert cross-engine parity).
 """
 
 from __future__ import annotations

@@ -1,0 +1,521 @@
+"""MXU slice-march volume rendering — the TPU-native raycaster core.
+
+The reference raycasts per pixel through GPU texture hardware: every march
+step does a trilinear texture fetch at an arbitrary world position
+(reference VDIGenerator.comp:333-529, VolumeRaycaster.comp:94-161). The
+literal translation — per-step random gathers into the ``[D, H, W]``
+volume — is the one access pattern a TPU cannot run fast: XLA lowers it to
+serialized HBM gathers (measured ~19 s/frame at 256³, 720p, 256 steps on a
+v5e chip). GPUs have texture units; TPUs have a 128×128 systolic array.
+So this module re-derives volume raycasting as matrix multiplication:
+
+1. Pick the volume axis ``w`` most aligned with the view direction
+   (`choose_axis`) and build a **virtual axis-aligned camera**: same eye,
+   looking straight down ``w``, off-axis frustum whose *near plane is the
+   nearest slice plane* and covers the whole volume footprint
+   (`make_axis_camera`). This is the shear-warp factorization of the view
+   transform, MXU-style.
+2. March slice by slice, front to back. Because every virtual-camera ray
+   passes through the eye, its crossing of slice ``w = z`` is a uniform
+   scale-and-shift of the intermediate pixel grid (scale ``s(z) =
+   depth(z)/depth(ref plane)``), so resampling a slice onto the whole ray
+   bundle is **separable bilinear** — two banded interpolation matrices
+   applied as ``Wv @ slice @ Wuᵀ``, built on the fly from ``iota`` and run
+   on the MXU. The hot loop contains no gathers at all.
+3. The per-slice samples feed any per-pixel fold: alpha-under
+   accumulation (plain image, ≅ AccumulatePlainImage.comp) or the
+   supersegment counting/writing machines (VDI generation,
+   ≅ AccumulateVDI.comp) — the same folds the gather-path raycaster uses.
+4. Outputs live on the virtual camera's pixel grid, and the virtual
+   camera's projection/view matrices go into `VDIMetadata`, so every
+   downstream consumer — sort-last compositor, novel-view VDI renderer,
+   streaming — works unchanged. For display, `warp_to_camera` reprojects
+   to the real camera: both cameras share an eye, so the warp is an exact
+   plane-induced homography (depth-independent, no parallax error).
+
+Sampling schedule vs the gather path: samples land exactly on slice
+planes (in-plane bilinear, exact in ``w``) instead of at uniform
+per-ray parameter steps; opacity correction by the per-ray inter-slice
+path length (`adjust_opacity`) makes the accumulated integral agree —
+parity is asserted by tests/test_slicer.py.
+
+The march axis and intermediate resolution are static (compile-time):
+an orbiting camera triggers at most one recompile per (axis, sign)
+regime, cached by jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
+from scenery_insitu_tpu.core.camera import Camera, frustum, look_at
+from scenery_insitu_tpu.core.transfer import TransferFunction
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
+from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.ops import supersegments as ss
+from scenery_insitu_tpu.ops.raycast import RaycastOutput, nominal_step
+from scenery_insitu_tpu.ops.sampling import adjust_opacity
+
+# xyz axis index -> data dim of Volume.data [z, y, x]
+_DATA_DIM = {0: 2, 1: 1, 2: 0}
+# march axis -> (u axis, v axis), both xyz indices
+_UV = {2: (0, 1), 1: (0, 2), 0: (1, 2)}
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisSpec:
+    """Static (compile-time) parameters of a slice march."""
+
+    axis: int                 # march axis, xyz index (0=x, 1=y, 2=z)
+    sign: int                 # +1: march toward +axis; -1: toward -axis
+    ni: int                   # intermediate image width (u direction)
+    nj: int                   # intermediate image height (v direction)
+    chunk: int = 16           # slices folded per scan step
+    matmul_dtype: str = "bf16"   # resampling matmul operand dtype
+    s_floor: float = 1e-3     # min depth ratio: slices closer are dropped
+
+    @property
+    def u_axis(self) -> int:
+        return _UV[self.axis][0]
+
+    @property
+    def v_axis(self) -> int:
+        return _UV[self.axis][1]
+
+
+def resolve_engine(engine: str) -> str:
+    """Resolve a render-engine name ("auto" | "mxu" | "gather") against the
+    current backend; raises on anything else so typos can't silently bench
+    the wrong engine."""
+    if engine == "auto":
+        return "mxu" if jax.default_backend() == "tpu" else "gather"
+    if engine not in ("mxu", "gather"):
+        raise ValueError(f"unknown render engine {engine!r} "
+                         "(expected 'auto', 'mxu' or 'gather')")
+    return engine
+
+
+def choose_axis(cam: Camera) -> Tuple[int, int]:
+    """Pick the volume axis most aligned with the view direction (host-side,
+    concrete camera). Returns (axis, sign)."""
+    d = np.asarray(cam.target, np.float64) - np.asarray(cam.eye, np.float64)
+    axis = int(np.argmax(np.abs(d)))
+    return axis, (1 if d[axis] >= 0 else -1)
+
+
+def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
+              cfg: Optional[SliceMarchConfig] = None,
+              axis_sign: Optional[Tuple[int, int]] = None,
+              multiple_of: int = 1) -> AxisSpec:
+    """Build the static spec for a camera + volume shape ([D, H, W]).
+
+    ``multiple_of``: round the intermediate dims up to this multiple — the
+    distributed pipeline needs ni divisible by the mesh size for its
+    width-axis all_to_all."""
+    cfg = cfg or SliceMarchConfig()
+    axis, sign = axis_sign or choose_axis(cam)
+    u_axis, v_axis = _UV[axis]
+    dims_xyz = (vol_shape[2], vol_shape[1], vol_shape[0])
+    step = 8 * multiple_of // np.gcd(8, multiple_of)
+    rnd = lambda n: max(step, int(-(-int(n * cfg.scale) // step)) * step)
+    # bf16 matmuls are MXU-native on TPU but emulated (slowly) on CPU
+    dtype = cfg.matmul_dtype
+    if dtype == "bf16" and jax.default_backend() != "tpu":
+        dtype = "f32"
+    return AxisSpec(axis=axis, sign=sign,
+                    ni=rnd(dims_xyz[u_axis]), nj=rnd(dims_xyz[v_axis]),
+                    chunk=cfg.chunk, matmul_dtype=dtype,
+                    s_floor=cfg.s_floor)
+
+
+class AxisCamera(NamedTuple):
+    """The traced (per-frame) state of the virtual axis-aligned camera.
+    All fields are jnp arrays; pairs with a static `AxisSpec`."""
+
+    eye_uvw: jnp.ndarray   # f32[3] eye in (u, v, w) component order
+    view: jnp.ndarray      # f32[4, 4]  (goes into VDIMetadata)
+    proj: jnp.ndarray      # f32[4, 4]  off-axis frustum projection
+    u_grid: jnp.ndarray    # f32[Ni] world u of intermediate pixel columns
+    v_grid: jnp.ndarray    # f32[Nj] world v of intermediate pixel rows
+    zp: jnp.ndarray        # f32[] eye→reference-plane distance (near plane)
+    w0: jnp.ndarray        # f32[] world w of marched slice 0 (= ref plane)
+    dwm: jnp.ndarray       # f32[] signed world w step per marched slice
+    far: jnp.ndarray       # f32[]
+
+    @property
+    def eye_u(self):
+        return self.eye_uvw[0]
+
+    @property
+    def eye_v(self):
+        return self.eye_uvw[1]
+
+    @property
+    def eye_w(self):
+        return self.eye_uvw[2]
+
+    def ray_lengths(self) -> jnp.ndarray:
+        """f32[Nj, Ni]: distance from the eye to each reference-plane grid
+        point = the ray parameter t at depth ratio s == 1."""
+        du = self.u_grid - self.eye_u
+        dv = self.v_grid - self.eye_v
+        return jnp.sqrt(dv[:, None] ** 2 + du[None, :] ** 2 + self.zp ** 2)
+
+
+def permute_volume(vol: Volume, spec: AxisSpec) -> jnp.ndarray:
+    """Volume data -> march layout ``[S, Nv, Nu]`` (slice, in-plane v, u),
+    flipped so marched slice index ascends front-to-back."""
+    perm = {2: (0, 1, 2), 1: (1, 0, 2), 0: (2, 0, 1)}[spec.axis]
+    volp = jnp.transpose(vol.data, perm)
+    if spec.sign < 0:
+        volp = jnp.flip(volp, axis=0)
+    return volp
+
+
+def make_axis_camera(vol: Volume, cam: Camera, spec: AxisSpec,
+                     box_min: Optional[jnp.ndarray] = None,
+                     box_max: Optional[jnp.ndarray] = None) -> AxisCamera:
+    """Build the virtual camera for this frame (all values traced).
+
+    box_min/box_max override the footprint AABB — the distributed pipeline
+    passes the *global* volume AABB so every rank shares one intermediate
+    grid (a requirement for the sort-last column exchange)."""
+    a, ua, va = spec.axis, spec.u_axis, spec.v_axis
+    box_min = vol.world_min if box_min is None else box_min
+    box_max = vol.world_max if box_max is None else box_max
+
+    eye = cam.eye
+    ew, eu, ev = eye[a], eye[ua], eye[va]
+    dw = vol.spacing[a]
+
+    # nearest slice plane (= reference/near plane) and signed march step.
+    # NOTE: w0 is derived from the *global* box when given, so all ranks of
+    # a decomposed volume agree on the slice ladder.
+    gw0 = box_min[a]
+    gw1 = box_max[a]
+    w0 = jnp.where(spec.sign > 0, gw0 + 0.5 * dw, gw1 - 0.5 * dw)
+    dwm = spec.sign * dw
+
+    zp = jnp.maximum(spec.sign * (w0 - ew), dw)            # eye may sit inside
+
+    # static unit basis of the virtual camera
+    fwd = np.zeros(3, np.float32)
+    fwd[a] = spec.sign
+    up = np.zeros(3, np.float32)
+    up[va] = 1.0
+    right = np.cross(fwd, up)
+    true_up = np.cross(right, fwd)
+    right_u = float(right[ua])                             # exactly ±1
+    up_v = float(true_up[va])
+
+    fwd_j = jnp.asarray(fwd)
+    right_j = jnp.asarray(right)
+    true_up_j = jnp.asarray(true_up)
+
+    view = look_at(eye, eye + fwd_j, jnp.asarray(up))
+
+    # off-axis frustum covering the box footprint projected from the eye
+    # onto the reference plane (corners closer than the plane clamp to it)
+    xs, ys, zs = [], [], []
+    for bits in range(8):
+        c = jnp.stack([(box_max if bits >> d & 1 else box_min)[d]
+                       for d in range(3)])
+        rel = c - eye
+        ze = jnp.dot(rel, fwd_j)
+        zec = jnp.maximum(ze, zp)
+        xs.append(jnp.dot(rel, right_j) * zp / zec)
+        ys.append(jnp.dot(rel, true_up_j) * zp / zec)
+        zs.append(ze)
+    xs, ys, zs = jnp.stack(xs), jnp.stack(ys), jnp.stack(zs)
+    mu = vol.spacing[ua]
+    mv = vol.spacing[va]
+    l, r = jnp.min(xs) - mu, jnp.max(xs) + mu
+    b, t = jnp.min(ys) - mv, jnp.max(ys) + mv
+    r = jnp.maximum(r, l + 1e-5)
+    t = jnp.maximum(t, b + 1e-5)
+    far = jnp.maximum(jnp.max(zs), zp * 1.001) + dw
+
+    proj = frustum(l, r, b, t, zp, far)
+
+    # intermediate pixel grids, consistent with the projection: column i
+    # center ↔ ndc_x = 2(i+.5)/Ni - 1; row j center ↔ ndc_y = 1 - 2(j+.5)/Nj
+    ndc_x = (jnp.arange(spec.ni, dtype=jnp.float32) + 0.5) / spec.ni * 2 - 1
+    ndc_y = 1.0 - (jnp.arange(spec.nj, dtype=jnp.float32) + 0.5) / spec.nj * 2
+    u_grid = eu + (ndc_x * (r - l) + (r + l)) * 0.5 * right_u
+    v_grid = ev + (ndc_y * (t - b) + (t + b)) * 0.5 * up_v
+
+    return AxisCamera(eye_uvw=jnp.stack([eu, ev, ew]), view=view, proj=proj,
+                      u_grid=u_grid, v_grid=v_grid, zp=zp, w0=w0, dwm=dwm,
+                      far=far)
+
+
+# ------------------------------------------------------------------ march
+
+
+def _axis_params(vol: Volume, spec: AxisSpec):
+    """(origin, spacing, count) of the u and v axes of this volume."""
+    ua, va = spec.u_axis, spec.v_axis
+    nu = vol.data.shape[_DATA_DIM[ua]]
+    nv = vol.data.shape[_DATA_DIM[va]]
+    return (vol.origin[ua], vol.spacing[ua], nu,
+            vol.origin[va], vol.spacing[va], nv)
+
+
+def _interp_matrix(pos: jnp.ndarray, origin, spacing, n: int,
+                   bounds: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
+                   ) -> jnp.ndarray:
+    """Banded bilinear interpolation weights for world positions ``pos
+    [C, M]`` against voxel rows 0..n-1 → ``[C, M, n]``. Clamp-to-edge
+    inside the volume extent, zero outside; `bounds` further restricts to a
+    half-open world interval (domain-decomposition ownership)."""
+    x = (pos - origin) / spacing - 0.5
+    valid = (x >= -0.5) & (x <= n - 0.5)
+    if bounds is not None:
+        valid &= (pos >= bounds[0]) & (pos < bounds[1])
+    xc = jnp.clip(x, 0.0, n - 1.0)
+    cols = jax.lax.broadcasted_iota(jnp.float32, (1, 1, n), 2)
+    w = jnp.maximum(0.0, 1.0 - jnp.abs(xc[..., None] - cols))
+    return w * valid[..., None].astype(jnp.float32)
+
+
+def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
+                spec: AxisSpec, consume: Callable, carry0,
+                u_bounds=None, v_bounds=None, step_scale: float = 1.0):
+    """The chunked slice march. Calls ``consume(carry, rgba [C,4,Nj,Ni],
+    t0 [C,Nj,Ni], t1 [C,Nj,Ni]) -> carry`` for each chunk of slices, front
+    to back, and returns the final carry.
+
+    rgba is premultiplied, already opacity-corrected for the per-ray
+    inter-slice path length, and zero outside the volume/ownership bounds.
+    """
+    volp = permute_volume(vol, spec)
+    s_total = volp.shape[0]
+    c = spec.chunk
+    nchunks = -(-s_total // c)
+    if nchunks * c != s_total:
+        pad = nchunks * c - s_total
+        volp = jnp.concatenate(
+            [volp, jnp.zeros((pad,) + volp.shape[1:], volp.dtype)], axis=0)
+
+    ou, su, nu, ov, sv, nv = _axis_params(vol, spec)
+    eu, ev, ew = axcam.eye_u, axcam.eye_v, axcam.eye_w
+    mm = jnp.bfloat16 if spec.matmul_dtype == "bf16" else jnp.float32
+
+    # per-ray geometry (constant over the march)
+    length = axcam.ray_lengths()                           # [Nj, Ni]
+    ds = jnp.abs(axcam.dwm) / axcam.zp                     # depth-ratio step
+    ratio = ds * length / (nominal_step(vol, step_scale))  # [Nj, Ni]
+
+    # the volume's own w ladder may start offset from the global one
+    # (distributed slabs): marched slice k of THIS volume sits at world
+    # w = local_w0 + k*dwm
+    a = spec.axis
+    now_ = vol.data.shape[_DATA_DIM[a]]
+    local_w0 = jnp.where(axcam.dwm > 0,
+                         vol.origin[a] + 0.5 * vol.spacing[a],
+                         vol.origin[a] + (now_ - 0.5) * vol.spacing[a])
+
+    def body(carry, ci):
+        ks = ci * c + jnp.arange(c, dtype=jnp.float32)     # [C]
+        wk = local_w0 + ks * axcam.dwm
+        sk = jnp.float32(spec.sign) * (wk - ew) / axcam.zp   # depth ratios
+        live = (sk > spec.s_floor) & (ks < s_total)
+
+        slices = jax.lax.dynamic_slice_in_dim(volp, ci * c, c, 0)  # [C,Nv,Nu]
+
+        pos_u = eu + (axcam.u_grid[None, :] - eu) * sk[:, None]    # [C, Ni]
+        pos_v = ev + (axcam.v_grid[None, :] - ev) * sk[:, None]    # [C, Nj]
+        wu = _interp_matrix(pos_u, ou, su, nu, u_bounds)           # [C,Ni,Nu]
+        wv = _interp_matrix(pos_v, ov, sv, nv, v_bounds)           # [C,Nj,Nv]
+
+        val = jnp.einsum("cjy,cyx,cix->cji",
+                         wv.astype(mm), slices.astype(mm), wu.astype(mm),
+                         preferred_element_type=jnp.float32)
+        val = jnp.clip(val, 0.0, 1.0)
+
+        rgb, alpha = tf(val)                               # [C,Nj,Ni,3], [C,Nj,Ni]
+        # outside-volume samples must be fully transparent even when the
+        # transfer function maps value 0 to nonzero alpha
+        inside = (wv.sum(-1) > 0.0)[:, :, None] & (wu.sum(-1) > 0.0)[:, None, :]
+        alpha = jnp.where(inside & live[:, None, None], alpha, 0.0)
+        alpha = adjust_opacity(alpha, ratio[None])
+        rgba = jnp.concatenate(
+            [jnp.moveaxis(rgb, -1, 1) * alpha[:, None], alpha[:, None]], axis=1)
+
+        t0 = sk[:, None, None] * length[None]
+        t1 = (sk + ds)[:, None, None] * length[None]
+        return consume(carry, rgba, t0, t1), None
+
+    carry, _ = jax.lax.scan(body, carry0, jnp.arange(nchunks))
+    return carry
+
+
+# ------------------------------------------------------- plain-image render
+
+
+def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
+                  spec: AxisSpec, early_exit_alpha: float = 0.999,
+                  u_bounds=None, v_bounds=None,
+                  step_scale: float = 1.0) -> RaycastOutput:
+    """Front-to-back alpha-under accumulation on the intermediate grid
+    (≅ VolumeRaycaster.comp, but slice-order). Background-free premultiplied
+    image + first-hit depth (ray parameter; +inf where empty)."""
+
+    def consume(carry, rgba, t0, t1):
+        acc, first_t = carry
+        for i in range(rgba.shape[0]):
+            gate = (acc[3] < early_exit_alpha).astype(jnp.float32)
+            src = rgba[i] * gate[None]
+            acc = acc + (1.0 - acc[3:4]) * src
+            first_t = jnp.where((first_t == jnp.inf) & (src[3] > 1e-4),
+                                t0[i], first_t)
+        return acc, first_t
+
+    acc0 = jnp.zeros((4, spec.nj, spec.ni), jnp.float32)
+    t0 = jnp.full((spec.nj, spec.ni), jnp.inf, jnp.float32)
+    acc, first_t = slice_march(vol, tf, axcam, spec, consume, (acc0, t0),
+                               u_bounds, v_bounds, step_scale)
+    return RaycastOutput(acc, first_t)
+
+
+def bilinear_image_sample(img: jnp.ndarray, gy: jnp.ndarray, gx: jnp.ndarray,
+                          fill: float = 0.0) -> jnp.ndarray:
+    """Sample ``img f32[ch, H, W]`` at continuous pixel coords (gy, gx)
+    ``[...]`` (pixel centers at integers). Out-of-range → fill."""
+    ch, h, w = img.shape
+    inb = (gx >= -0.5) & (gx <= w - 0.5) & (gy >= -0.5) & (gy <= h - 0.5)
+    x = jnp.clip(gx, 0.0, w - 1.0)
+    y = jnp.clip(gy, 0.0, h - 1.0)
+    x0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, max(w - 2, 0))
+    y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, max(h - 2, 0))
+    fx = x - x0
+    fy = y - y0
+    flat = img.reshape(ch, h * w)
+
+    def at(yi, xi):
+        return jnp.take(flat, yi * w + xi, axis=1)
+
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    out = (at(y0, x0) * ((1 - fx) * (1 - fy))[None]
+           + at(y0, x1) * (fx * (1 - fy))[None]
+           + at(y1, x0) * ((1 - fx) * fy)[None]
+           + at(y1, x1) * (fx * fy)[None])
+    return jnp.where(inb[None], out, fill)
+
+
+def warp_to_camera(image: jnp.ndarray, axcam: AxisCamera, spec: AxisSpec,
+                   cam: Camera, width: int, height: int,
+                   background: Optional[Tuple[float, ...]] = (0.0, 0.0, 0.0, 0.0),
+                   fill: float = 0.0, nearest: bool = False) -> jnp.ndarray:
+    """Resample an intermediate-grid image ``[ch, Nj, Ni]`` to the real
+    camera's ``[ch, H, W]``. Exact: both cameras share an eye, so the map
+    is the homography induced by the reference plane. ``fill`` is used for
+    rays that miss the reference plane or fall outside the grid;
+    ``background`` (4-channel images only) is alpha-under-composited.
+    ``nearest`` disables bilinear blending — required for channels with
+    sentinel values (depth maps), where blending a sentinel with a valid
+    neighbor would fabricate a value."""
+    from scenery_insitu_tpu.core.camera import pixel_rays
+
+    _, dirs = pixel_rays(cam, width, height)               # [3, H, W]
+    de = jnp.float32(spec.sign) * dirs[spec.axis]
+    hit = de > 1e-6
+    tp = axcam.zp / jnp.where(hit, de, 1.0)
+    pu = axcam.eye_u + tp * dirs[spec.u_axis]
+    pv = axcam.eye_v + tp * dirs[spec.v_axis]
+    du = axcam.u_grid[1] - axcam.u_grid[0]
+    dv = axcam.v_grid[1] - axcam.v_grid[0]
+    gi = (pu - axcam.u_grid[0]) / du
+    gj = (pv - axcam.v_grid[0]) / dv
+    if nearest:
+        gi = jnp.round(gi)
+        gj = jnp.round(gj)
+    out = bilinear_image_sample(image, gj, gi, fill)
+    out = jnp.where(hit[None], out, fill)
+    if background is None:
+        return out
+    bg = jnp.asarray(background, jnp.float32).reshape(-1, 1, 1)
+    return out + (1.0 - out[3:4]) * bg
+
+
+def raycast_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
+                width: int, height: int, spec: AxisSpec,
+                early_exit_alpha: float = 0.999,
+                background: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0),
+                step_scale: float = 1.0) -> RaycastOutput:
+    """Full plain render: slice march on the intermediate grid + homography
+    warp to the display camera. Drop-in output-compatible with
+    ops.raycast.raycast."""
+    axcam = make_axis_camera(vol, cam, spec)
+    inter = render_slices(vol, tf, axcam, spec, early_exit_alpha,
+                          step_scale=step_scale)
+    img = warp_to_camera(inter.image, axcam, spec, cam, width, height,
+                         background)
+    # depth: nearest-sample warp with -1 standing in for "empty" (bilinear
+    # would blend the sentinel with valid neighbors at silhouette pixels)
+    depth = warp_to_camera(
+        jnp.where(jnp.isfinite(inter.depth), inter.depth, -1.0)[None],
+        axcam, spec, cam, width, height, background=None, fill=-1.0,
+        nearest=True)[0]
+    depth = jnp.where(depth >= 0.0, depth, jnp.inf)
+    return RaycastOutput(img, depth)
+
+
+# ----------------------------------------------------------- VDI generation
+
+
+def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
+                     spec: AxisSpec, cfg: Optional[VDIConfig] = None,
+                     frame_index: int = 0,
+                     box_min: Optional[jnp.ndarray] = None,
+                     box_max: Optional[jnp.ndarray] = None,
+                     u_bounds=None, v_bounds=None,
+                     ) -> Tuple[VDI, VDIMetadata, AxisCamera]:
+    """VDI generation on the MXU slice march (≅ VDIGenerator.comp +
+    AccumulateVDI.comp, see ops.vdi_gen for the gather-path equivalent).
+
+    The VDI lives on the virtual camera's pixel grid; its metadata carries
+    the virtual projection/view, so compositing, novel-view rendering and
+    streaming treat it exactly like a gather-path VDI. Depths are the world
+    ray parameter of the (virtual = real) eye.
+    """
+    cfg = cfg or VDIConfig()
+    k = cfg.max_supersegments
+    nj, ni = spec.nj, spec.ni
+    axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
+
+    march = lambda consume, carry0: slice_march(
+        vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds)
+
+    if cfg.adaptive:
+        def count_fn(thr):
+            def consume(st, rgba, t0, t1):
+                for i in range(rgba.shape[0]):
+                    st = ss.push_count(st, thr, rgba[i])
+                return st
+            return march(consume, ss.init_count(nj, ni)).count
+        threshold = ss.adaptive_threshold(count_fn, k, cfg.adaptive_iters,
+                                          nj, ni)
+    else:
+        threshold = jnp.full((nj, ni), cfg.threshold, jnp.float32)
+
+    def consume(st, rgba, t0, t1):
+        for i in range(rgba.shape[0]):
+            st = ss.push(st, k, threshold, rgba[i], t0[i], t1[i])
+        return st
+
+    state = march(consume, ss.init_state(k, nj, ni))
+    color, depth = ss.finalize(state)
+
+    dims = jnp.asarray(vol.dims_xyz, jnp.float32)
+    meta = VDIMetadata.create(projection=axcam.proj, view=axcam.view,
+                              volume_dims=dims, window_dims=(ni, nj),
+                              nw=nominal_step(vol), index=frame_index)
+    return VDI(color, depth), meta, axcam

@@ -111,6 +111,79 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
     return jax.jit(f)
 
 
+def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
+                             spec, vdi_cfg: Optional[VDIConfig] = None,
+                             comp_cfg: Optional[CompositeConfig] = None,
+                             axis_name: Optional[str] = None):
+    """Distributed sort-last VDI pipeline on the MXU slice-march engine
+    (ops/slicer.py) — generation runs as banded-matmul slice resampling
+    instead of per-ray gathers; the rest of the chain (width-axis
+    ``all_to_all``, sort-merge composite) is unchanged.
+
+    ``spec`` is the static `slicer.AxisSpec` for the *current camera
+    regime* (march axis/sign + intermediate resolution); the session keeps
+    one jitted step per regime. The output VDI lives on the virtual
+    axis camera's global pixel grid, sharded over its width (i) axis.
+
+    Domain decomposition is the same z-slab sharding as
+    `distributed_vdi_step`; ownership of in-plane samples is half-open per
+    rank, halo rows make boundary interpolation seam-exact.
+    """
+    from scenery_insitu_tpu.ops import slicer
+
+    vdi_cfg = vdi_cfg or VDIConfig()
+    comp_cfg = comp_cfg or CompositeConfig()
+    axis = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    if spec.ni % n:
+        raise ValueError(f"intermediate width {spec.ni} not divisible by "
+                         f"mesh size {n}")
+
+    def step(local_data, origin, spacing, cam: Camera):
+        r = jax.lax.axis_index(axis)
+        dn = local_data.shape[0]
+        h, w = local_data.shape[1], local_data.shape[2]
+        dz = spacing[2]
+        gmax = origin + jnp.array([w, h, dn * n], jnp.float32) * spacing
+
+        if spec.axis == 2:
+            # march along the domain axis: each rank marches only its own
+            # slab slices — no halo, no ownership masks needed
+            local_origin = origin.at[2].add(r * dn * dz)
+            vol = Volume(local_data, local_origin, spacing)
+            v_bounds = None
+        else:
+            # march along x/y: the in-plane v axis is the sharded z axis —
+            # halo rows for seam-exact bilinear, half-open ownership so
+            # every sample belongs to exactly one rank
+            halo = halo_exchange_z(local_data, axis)       # [Dn+2, H, W]
+            local_origin = origin.at[2].add((r * dn - 1) * dz)
+            vol = Volume(halo, local_origin, spacing)
+            z_lo = origin[2] + r * dn * dz
+            z_hi = origin[2] + (r + 1) * dn * dz
+            v_bounds = (jnp.where(r == 0, -jnp.inf, z_lo),
+                        jnp.where(r == n - 1, jnp.inf, z_hi))
+
+        vdi, meta, _ = slicer.generate_vdi_mxu(
+            vol, tf, cam, spec, vdi_cfg,
+            box_min=origin, box_max=gmax, v_bounds=v_bounds)
+        # metadata must describe the GLOBAL volume, not this rank's slab
+        meta = meta._replace(
+            volume_dims=jnp.array([w, h, dn * n], jnp.float32))
+        colors = _exchange_columns(vdi.color, n, axis)     # [n,K,4,Nj,Ni/n]
+        depths = _exchange_columns(vdi.depth, n, axis)
+        return composite_vdis(colors, depths, comp_cfg), meta
+
+    spec_vol = P(axis, None, None)
+    from scenery_insitu_tpu.core.vdi import VDIMetadata
+    out_vdi = VDI(P(None, None, None, axis), P(None, None, None, axis))
+    out_meta = VDIMetadata(*(P() for _ in VDIMetadata._fields))
+    f = shard_map(step, mesh=mesh,
+                  in_specs=(spec_vol, P(), P(), P()),
+                  out_specs=(out_vdi, out_meta), check_vma=False)
+    return jax.jit(f)
+
+
 def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            width: int, height: int,
                            cfg: Optional[RenderConfig] = None,

@@ -92,11 +92,20 @@ class InSituSession:
         self._pending_meta = {}  # frame index -> VDIMetadata at dispatch
 
         r = self.cfg.render
-        if self.cfg.runtime.generate_vdis:
+        from scenery_insitu_tpu.ops import slicer as _slicer
+        self._slicer = _slicer
+        # engine selection: the MXU slice march is implemented for the VDI
+        # pipeline; plain-image mode always uses the gather path
+        self.engine = _slicer.resolve_engine(self.cfg.slicer.engine)
+        if self.cfg.runtime.generate_vdis and self.engine == "mxu":
+            self._step = None
+            self._mxu_steps = {}   # (axis, sign) -> jitted distributed step
+        elif self.cfg.runtime.generate_vdis:
             self._step = distributed_vdi_step(
                 self.mesh, self.tf, r.width, r.height,
                 self.cfg.vdi, self.cfg.composite, max_steps=r.max_steps)
         else:
+            self.engine = "gather"
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r)
 
@@ -124,11 +133,17 @@ class InSituSession:
             self.sim.advance(self.cfg.sim.steps_per_frame)
         with self.timers.phase("dispatch"):
             field = shard_volume(self.sim.field, self.mesh)
-            out = self._step(field, self._origin, self._spacing, self.camera)
+            if self._step is not None:
+                out = self._step(field, self._origin, self._spacing,
+                                 self.camera)
+                meta = self.frame_metadata(self.frame_index)
+            else:
+                out, meta = self._mxu_step()(field, self._origin,
+                                             self._spacing, self.camera)
+                meta = meta._replace(index=jnp.int32(self.frame_index))
         # metadata snapshot BEFORE the camera advances (fetch is pipelined
         # one frame behind, so it must not see the next frame's pose)
-        self._pending_meta[self.frame_index] = \
-            self.frame_metadata(self.frame_index)
+        self._pending_meta[self.frame_index] = meta
         if self.orbit_rate:
             self.camera = orbit(self.camera, jnp.float32(self.orbit_rate))
         self.frame_index += 1
@@ -163,6 +178,24 @@ class InSituSession:
             for s in self.sinks:
                 s(index, payload)
         return payload
+
+    def _mxu_step(self):
+        """Jitted MXU distributed step for the camera's current march
+        regime; one compilation per (axis, sign), cached (the camera may
+        orbit across axis boundaries mid-session)."""
+        from scenery_insitu_tpu.parallel.pipeline import distributed_vdi_step_mxu
+
+        regime = self._slicer.choose_axis(self.camera)
+        step = self._mxu_steps.get(regime)
+        if step is None:
+            n = self.mesh.shape[self.cfg.mesh.axis_name]
+            spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
+                                          self.cfg.slicer, axis_sign=regime,
+                                          multiple_of=n)
+            step = distributed_vdi_step_mxu(self.mesh, self.tf, spec,
+                                            self.cfg.vdi, self.cfg.composite)
+            self._mxu_steps[regime] = step
+        return step
 
     def frame_metadata(self, index: int):
         """VDIMetadata for the current camera/volume placement (≅ the

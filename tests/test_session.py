@@ -49,3 +49,25 @@ def test_session_orbit_changes_camera():
     eye0 = np.asarray(sess.camera.eye)
     sess.run(2)
     assert not np.allclose(eye0, np.asarray(sess.camera.eye))
+
+
+def test_session_mxu_engine(tmp_path):
+    """Session with the MXU slice-march engine: VDI frames on the virtual
+    camera grid, metadata from the pipeline, engine cache per march regime."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+
+    cfg = FrameworkConfig().with_overrides(
+        "slicer.engine=mxu", "slicer.scale=1.0",
+        "sim.grid=[16,16,16]", "sim.steps_per_frame=2",
+        "vdi.max_supersegments=6", "vdi.adaptive_iters=2",
+        "composite.max_output_supersegments=8", "mesh.num_devices=4")
+    s = InSituSession(cfg)
+    payload = s.run(3)
+    assert s.engine == "mxu"
+    assert payload["frame"] == 2
+    assert payload["vdi_color"].ndim == 4
+    ni = payload["vdi_color"].shape[-1]
+    assert ni % 4 == 0                      # divisible by mesh size
+    assert np.isfinite(payload["vdi_color"]).all()
+    assert int(payload["meta"].index) == 2
+    assert len(s._mxu_steps) == 1

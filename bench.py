@@ -60,7 +60,8 @@ def main():
         vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters),
         comp_cfg=CompositeConfig(max_output_supersegments=k,
                                  adaptive_iters=ad_iters),
-        engine=engine, grid_shape=(grid, grid, grid))
+        engine=engine, grid_shape=(grid, grid, grid),
+        axis_sign=slicer.choose_axis(base) if engine == "mxu" else None)
 
     # the mxu step is compiled for the base camera's march regime (axis z
     # here); oscillate the orbit within ±0.35 rad so every benched frame

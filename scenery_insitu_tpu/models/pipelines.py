@@ -35,9 +35,11 @@ def grayscott_vdi_frame_step(width: int, height: int,
     (jittable; the flagship single-device hot path).
 
     engine="mxu" uses the slice-march raycaster (ops/slicer.py; requires
-    the static ``grid_shape``; ``axis_sign`` pins the march regime —
-    cameras outside that regime need a rebuilt step). The VDI then lives on
-    the virtual axis camera's grid instead of (width, height). "auto"
+    the static ``grid_shape`` AND ``axis_sign`` — the march regime, from
+    ``slicer.choose_axis(camera)`` on a representative camera. Eyes the
+    returned step is called with must stay inside that regime (within 45°
+    of the axis); build one step per regime otherwise). The VDI then lives
+    on the virtual axis camera's grid instead of (width, height). "auto"
     resolves to mxu on TPU, gather elsewhere."""
     from scenery_insitu_tpu.ops import slicer
 
@@ -52,6 +54,11 @@ def grayscott_vdi_frame_step(width: int, height: int,
     if engine == "mxu":
         if grid_shape is None:
             raise ValueError("engine='mxu' needs the static grid_shape")
+        if axis_sign is None:
+            raise ValueError(
+                "engine='mxu' needs axis_sign — pass "
+                "slicer.choose_axis(cam) for a camera representative of "
+                "the eyes this step will be called with")
         spec = slicer.make_spec(
             Camera.create((0.0, 0.6, 3.0), fov_y_deg=fov_y_deg),
             tuple(grid_shape), slicer_cfg, axis_sign=axis_sign)

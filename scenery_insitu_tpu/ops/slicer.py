@@ -122,7 +122,7 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     axis, sign = axis_sign or choose_axis(cam)
     u_axis, v_axis = _UV[axis]
     dims_xyz = (vol_shape[2], vol_shape[1], vol_shape[0])
-    step = 8 * multiple_of // np.gcd(8, multiple_of)
+    step = int(8 * multiple_of // np.gcd(8, multiple_of))
     rnd = lambda n: max(step, int(-(-int(n * cfg.scale) // step)) * step)
     # bf16 matmuls are MXU-native on TPU but emulated (slowly) on CPU
     dtype = cfg.matmul_dtype

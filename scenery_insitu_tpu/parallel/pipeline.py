@@ -161,8 +161,12 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
             vol = Volume(halo, local_origin, spacing)
             z_lo = origin[2] + r * dn * dz
             z_hi = origin[2] + (r + 1) * dn * dz
-            v_bounds = (jnp.where(r == 0, -jnp.inf, z_lo),
-                        jnp.where(r == n - 1, jnp.inf, z_hi))
+            # edge ranks keep the exact global extent as their bound (the
+            # clamped halo row must never render the band beyond it, which
+            # single-device treats as outside the volume); the +dz slack on
+            # the last rank only re-admits pos == global max, which the
+            # volume-extent mask in _interp_matrix still caps
+            v_bounds = (z_lo, jnp.where(r == n - 1, z_hi + dz, z_hi))
 
         vdi, meta, _ = slicer.generate_vdi_mxu(
             vol, tf, cam, spec, vdi_cfg,

@@ -1,0 +1,87 @@
+"""Tests for the render benchmark harness (runtime/benchmark.py +
+benchmarks/render_bench.py CLI): sweep stats, CSV format, flythrough
+interpolation and the CLI end-to-end at tiny sizes."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.runtime.benchmark import (benchmark_views, fps_csv,
+                                                  interpolate_path,
+                                                  record_flythrough)
+
+
+def _cam():
+    return Camera.create((0.0, 0.4, 2.5), fov_y_deg=45.0, near=0.3, far=10.0)
+
+
+def test_benchmark_views_and_csv(tmp_path):
+    calls = []
+
+    def render(cam):
+        calls.append(np.asarray(cam.eye))
+        return jnp.full((4, 8, 8), 0.5)
+
+    results = benchmark_views(render, _cam(), num_views=3, frames=2,
+                              warmup=1, screenshot_dir=str(tmp_path))
+    assert len(results) == 3
+    assert all(st.n == 2 for _, st in results)
+    # 3 views x (1 warmup + 2 timed)
+    assert len(calls) == 9
+    # distinct eyes per view
+    eyes = {tuple(np.round(calls[i * 3], 4)) for i in range(3)}
+    assert len(eyes) == 3
+    assert sorted(os.listdir(tmp_path)) == ["view00.png", "view01.png",
+                                            "view02.png"]
+
+    csv = fps_csv(results)
+    lines = csv.strip().split("\n")
+    assert lines[0].startswith("yaw_deg;avg_fps")
+    assert len(lines) == 4
+    row = lines[1].split(";")
+    assert len(row) == 6 and int(row[5]) == 2
+    # min_fps <= avg_fps <= max_fps
+    assert float(row[2]) <= float(row[1]) <= float(row[3])
+
+
+def test_interpolate_path_endpoints():
+    a = _cam()
+    b = Camera.create((2.0, 0.0, 0.5), target=(0.1, 0.0, 0.0),
+                      fov_y_deg=60.0)
+    path = interpolate_path([a, b], frames_per_segment=4)
+    assert len(path) == 5
+    assert np.allclose(np.asarray(path[0].eye), np.asarray(a.eye))
+    assert np.allclose(np.asarray(path[-1].eye), np.asarray(b.eye))
+    # monotone progress along the segment
+    xs = [float(c.eye[0]) for c in path]
+    assert all(x1 <= x2 + 1e-6 for x1, x2 in zip(xs, xs[1:]))
+
+
+def test_record_flythrough(tmp_path):
+    render = lambda cam: jnp.full((4, 8, 8), 0.3)
+    path = interpolate_path([_cam(), Camera.create((0.0, 0.4, -2.5))], 3)
+    n = record_flythrough(render, path, str(tmp_path / "fly"))
+    assert n == len(path)
+    assert len(os.listdir(tmp_path / "fly")) == n
+
+
+def test_render_bench_cli(tmp_path):
+    env = dict(os.environ,
+               PYTHONPATH="/root/repo",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "/root/repo/benchmarks/render_bench.py",
+         "--grid", "16", "--views", "2", "--frames", "2", "--width", "32",
+         "--height", "24", "--steps", "24", "--engine", "gather",
+         "--out-dir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = out.stdout.strip().split("\n")
+    assert lines[0].startswith("yaw_deg") and len(lines) == 3
+    assert os.path.exists(tmp_path / "fps_procedural_gather_plain.csv")
+    shots = tmp_path / "procedural_gather_plain"
+    assert sorted(os.listdir(shots)) == ["view00.png", "view01.png"]

@@ -1,18 +1,26 @@
-"""Shared-memory ingest micro-benchmark (≅ the reference's IPC transport
-matrix: sem/heap/sysv/mmap/fifo/tcp × 1 KB–1 GB × 5000 iters,
-src/test/cpp/benchmark/test_params.hpp:21-44, and the C++↔JVM TestConsumer
-harness). Measures the TPU-relevant chain: producer memcpy → shm → consumer
-(zero-copy pin vs copy) → optional device_put to HBM.
+"""IPC ingest micro-benchmark (≅ the reference's transport matrix:
+sem/heap/sysv/mmap/fifo/tcp × 1 KB–1 GB × 5000 iters,
+src/test/cpp/benchmark/test_params.hpp:21-44, test_producer.cpp,
+test_consumer.cpp, and the C++↔JVM TestConsumer harness).
+
+Transports benchmarked here:
+- shm ring (the framework's C++ transport): publish, consume(copy),
+  consume(zero-copy pin) — the TPU-relevant chain, optionally + device_put
+  to HBM (--device).
+- mmap file, FIFO pipe, TCP loopback (--matrix): the classical alternatives
+  the reference measures, to show why the shm ring is the default.
 
 Usage: python benchmarks/ingest_bench.py [--iters 200] [--max-mb 64]
-       [--device]
-Prints one row per size: publish, consume(copy), consume(pin), and with
---device the host→HBM hop.
+       [--device] [--matrix]
+Prints one row per size per transport, MB/s per hop.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import socket
+import threading
 import time
 import uuid
 
@@ -71,17 +79,122 @@ def bench_size(nfloats: int, iters: int, device: bool):
         prod.close()
 
 
+def bench_mmap(nfloats: int, iters: int) -> float:
+    """Round-trip through an mmapped file (≅ the PosixMemory strategy,
+    reference benchmark/TestConsumer.kt:88-143). Returns seconds/frame."""
+    import mmap
+
+    path = f"/dev/shm/sitpu_mmap_{uuid.uuid4().hex[:8]}"
+    frame = np.random.default_rng(0).random(nfloats).astype(np.float32)
+    try:
+        with open(path, "wb+") as f:
+            f.truncate(frame.nbytes)
+            mm = mmap.mmap(f.fileno(), frame.nbytes)
+        view = np.frombuffer(mm, np.float32)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            view[:] = frame                      # producer write
+            _ = view.copy()                      # consumer read
+        dt = (time.perf_counter() - t0) / iters
+        mm.close()
+        return dt
+    finally:
+        os.unlink(path)
+
+
+def bench_fifo(nfloats: int, iters: int) -> float:
+    """Round-trip through a named pipe (≅ the FIFO strategy,
+    test_params.hpp:21-44). Returns seconds/frame."""
+    path = f"/tmp/sitpu_fifo_{uuid.uuid4().hex[:8]}"
+    os.mkfifo(path)
+    frame = np.random.default_rng(0).random(nfloats).astype(np.float32)
+    stop = []
+
+    def producer():
+        with open(path, "wb") as f:
+            for _ in range(iters):
+                f.write(frame.tobytes())
+                f.flush()
+
+    try:
+        th = threading.Thread(target=producer, daemon=True)
+        th.start()
+        nbytes = frame.nbytes
+        t0 = time.perf_counter()
+        with open(path, "rb") as f:
+            for _ in range(iters):
+                got = f.read(nbytes)
+                while len(got) < nbytes:
+                    got += f.read(nbytes - len(got))
+        dt = (time.perf_counter() - t0) / iters
+        th.join(timeout=10)
+        return dt
+    finally:
+        os.unlink(path)
+
+
+def bench_tcp(nfloats: int, iters: int) -> float:
+    """Round-trip over a TCP loopback socket (≅ the TCP strategy,
+    test_params.hpp:21-44). Returns seconds/frame."""
+    frame = np.random.default_rng(0).random(nfloats).astype(np.float32)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def producer():
+        s = socket.socket()
+        s.connect(("127.0.0.1", port))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        data = frame.tobytes()
+        for _ in range(iters):
+            s.sendall(data)
+        s.close()
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    conn, _ = srv.accept()
+    nbytes = frame.nbytes
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        got = 0
+        while got < nbytes:
+            chunk = conn.recv(min(1 << 20, nbytes - got))
+            if not chunk:
+                raise IOError("producer closed early")
+            got += len(chunk)
+    dt = (time.perf_counter() - t0) / iters
+    conn.close()
+    srv.close()
+    th.join(timeout=10)
+    return dt
+
+
+def bench_matrix(nfloats: int, iters: int) -> None:
+    mb = nfloats * 4 / 1e6
+    rows = [("mmap", bench_mmap), ("fifo", bench_fifo), ("tcp", bench_tcp)]
+    cells = []
+    for name, fn in rows:
+        dt = fn(nfloats, iters)
+        cells.append(f"{name} {mb / dt:9.0f} MB/s")
+    print(f"{nfloats * 4:>12} B: " + "  ".join(cells))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=200)
     ap.add_argument("--max-mb", type=float, default=64.0)
     ap.add_argument("--device", action="store_true",
                     help="include the host->HBM device_put hop")
+    ap.add_argument("--matrix", action="store_true",
+                    help="also benchmark mmap/fifo/tcp alternatives")
     args = ap.parse_args()
 
     n = 256
     while n * 4 <= args.max_mb * 1e6:
         bench_size(n, max(args.iters, 3), args.device)
+        if args.matrix:
+            bench_matrix(n, max(args.iters, 3))
         n *= 4
 
 

@@ -245,6 +245,48 @@ void shm_consumer_release(void* handle, int32_t slot) {
     h->ctl->slots[slot].readers.fetch_sub(1, std::memory_order_acq_rel);
 }
 
+// ------------------------------------------------------- inspect / recover
+//
+// ≅ the reference's stuck-state debug CLIs sem_get.cpp (print semaphore
+// state for a rank) and sem_reset.cpp (zero it to recover a wedged
+// protocol). The ring's state is plain atomics in the control block, so
+// inspection is a read and recovery is clearing stale reader pins left by
+// crashed consumers.
+
+// Fills out[0..7+2*nslots): nslots, slot_size, next_seq, latest(+1, so 0
+// means "none"), waiters, writer_attached, frames_dropped, then per slot
+// (readers, seq). Returns the number of u64s written, or 0 if out_len is
+// too small.
+uint32_t shm_channel_stats(void* handle, uint64_t* out, uint32_t out_len) {
+  Handle* h = static_cast<Handle*>(handle);
+  Control* c = h->ctl;
+  uint32_t need = 7 + 2 * c->nslots;
+  if (out_len < need) return 0;
+  out[0] = c->nslots;
+  out[1] = c->slot_size;
+  out[2] = c->next_seq.load(std::memory_order_acquire);
+  out[3] = static_cast<uint64_t>(c->latest.load(std::memory_order_acquire) + 1);
+  out[4] = c->waiters.load(std::memory_order_acquire);
+  out[5] = c->writer_attached.load(std::memory_order_acquire);
+  out[6] = c->frames_dropped.load(std::memory_order_relaxed);
+  for (uint32_t i = 0; i < c->nslots; ++i) {
+    out[7 + 2 * i] = c->slots[i].readers.load(std::memory_order_acquire);
+    out[8 + 2 * i] = c->slots[i].seq.load(std::memory_order_acquire);
+  }
+  return need;
+}
+
+// Clears all reader pins (crashed consumers leak them, which eventually
+// starves shm_producer_acquire). Returns the number of pins cleared.
+uint32_t shm_channel_reset_readers(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  Control* c = h->ctl;
+  uint32_t cleared = 0;
+  for (uint32_t i = 0; i < c->nslots; ++i)
+    cleared += c->slots[i].readers.exchange(0, std::memory_order_acq_rel);
+  return cleared;
+}
+
 // ------------------------------------------------------------------ common
 
 void shm_channel_close(void* handle) {

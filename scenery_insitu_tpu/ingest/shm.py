@@ -67,8 +67,62 @@ def _load():
     lib.shm_channel_close.argtypes = [ctypes.c_void_p]
     lib.shm_channel_unlink.restype = ctypes.c_int
     lib.shm_channel_unlink.argtypes = [ctypes.c_char_p]
+    lib.shm_channel_stats.restype = ctypes.c_uint32
+    lib.shm_channel_stats.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.c_uint32]
+    lib.shm_channel_reset_readers.restype = ctypes.c_uint32
+    lib.shm_channel_reset_readers.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
+
+
+def channel_stats(channel: str) -> dict:
+    """Inspect a live channel's control block (≅ sem_get.cpp's semaphore
+    dump, reference src/test/cpp/sem_get.cpp). Raises FileNotFoundError if
+    the channel does not exist."""
+    lib = _load()
+    h = lib.shm_consumer_open(channel.encode())
+    if not h:
+        raise FileNotFoundError(f"no shm channel {channel!r}")
+    try:
+        buf = (ctypes.c_uint64 * 32)()
+        n = lib.shm_channel_stats(h, buf, 32)
+        vals = list(buf[:n])
+        nslots = int(vals[0])
+        return {
+            "channel": channel,
+            "nslots": nslots,
+            "slot_bytes": int(vals[1]),
+            "last_seq": int(vals[2]),
+            "latest_slot": int(vals[3]) - 1,
+            "waiters": int(vals[4]),
+            "writer_attached": bool(vals[5]),
+            "frames_dropped": int(vals[6]),
+            "slots": [{"readers": int(vals[7 + 2 * i]),
+                       "seq": int(vals[8 + 2 * i])}
+                      for i in range(nslots)],
+        }
+    finally:
+        lib.shm_channel_close(h)
+
+
+def reset_readers(channel: str) -> int:
+    """Clear stale reader pins left by crashed consumers (≅ sem_reset.cpp's
+    stuck-semaphore recovery). Returns the number of pins cleared."""
+    lib = _load()
+    h = lib.shm_consumer_open(channel.encode())
+    if not h:
+        raise FileNotFoundError(f"no shm channel {channel!r}")
+    try:
+        return int(lib.shm_channel_reset_readers(h))
+    finally:
+        lib.shm_channel_close(h)
+
+
+def unlink(channel: str) -> bool:
+    """Remove a channel from the namespace (live handles keep their maps)."""
+    return _load().shm_channel_unlink(channel.encode()) == 0
 
 
 class ShmProducer:

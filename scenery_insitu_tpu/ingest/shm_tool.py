@@ -1,0 +1,53 @@
+"""Shm-channel debug and recovery CLI (≅ the reference's stuck-state
+tooling: sem_get.cpp prints a rank's semaphore state, sem_reset.cpp zeroes
+it — src/test/cpp/sem_get.cpp, sem_reset.cpp).
+
+Usage:
+  python -m scenery_insitu_tpu.ingest.shm_tool NAME           # inspect
+  python -m scenery_insitu_tpu.ingest.shm_tool NAME --reset   # clear pins
+  python -m scenery_insitu_tpu.ingest.shm_tool NAME --unlink  # remove
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("channel", help="channel name, e.g. /sitpu_vol")
+    p.add_argument("--reset", action="store_true",
+                   help="clear stale reader pins (crashed-consumer recovery)")
+    p.add_argument("--unlink", action="store_true",
+                   help="remove the channel from the shm namespace")
+    p.add_argument("--json", action="store_true", help="machine output")
+    args = p.parse_args(argv)
+
+    from scenery_insitu_tpu.ingest import shm
+
+    try:
+        stats = shm.channel_stats(args.channel)
+    except FileNotFoundError:
+        print(f"no channel {args.channel!r}", file=sys.stderr)
+        return 1
+
+    if args.reset:
+        stats["pins_cleared"] = shm.reset_readers(args.channel)
+    if args.unlink:
+        stats["unlinked"] = shm.unlink(args.channel)
+
+    if args.json:
+        print(json.dumps(stats))
+    else:
+        slots = stats.pop("slots")
+        for k, v in stats.items():
+            print(f"{k:16}: {v}")
+        for i, s in enumerate(slots):
+            print(f"slot {i}: readers={s['readers']} seq={s['seq']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,157 @@
+"""VDI depth-convention conversion and validation
+(≅ reference VDIConverter.kt:44-275 + ConvertToNDC.comp:59-239).
+
+The reference accumulated three depth encodings behind #defines (NDC-z,
+world distance, integer step counts — VDIGenerator.comp:41-43,
+AccumulateVDI.comp:108-128) and needed a whole GPU pass (ConvertToNDC.comp)
+to normalize stored VDIs before novel-view rendering. This framework keeps
+ONE internal encoding — the world-space ray parameter t of the generating
+camera (core/vdi.py docstring) — and this module is the explicit boundary
+converter for interchange with reference-convention consumers:
+
+- ``depths_to_ndc`` / ``depths_from_ndc``: world-t ↔ NDC-z of the
+  generating camera (exact, analytic per pixel; works for the off-axis
+  virtual cameras the MXU slice-march engine produces, because everything
+  goes through the metadata's projection/view matrices).
+- ``pack_reference_layout`` / ``unpack_reference_layout``: the reference's
+  GPU texture layouts — color rgba32f ``[K, H, W, 4]`` and depth r32f
+  ``[2K, H, W]`` with start/end interleaved (OutputSubVDIColor/
+  OutputSubVDIDepth, reference DistributedVolumes.kt:331-368).
+- ``validate_vdi``: the monotonicity/range assertions ConvertToNDC.comp
+  carried as debugPrintf error paths (:155-157, 197-208), as a host-side
+  report.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from scenery_insitu_tpu.core.camera import _normalize
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
+from scenery_insitu_tpu.ops.vdi_render import original_eye
+
+
+def rays_from_metadata(meta: VDIMetadata) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-pixel world rays of the generating camera, reconstructed from
+    the metadata matrices (generalizes camera.pixel_rays to any projection,
+    including the slice-march engine's off-axis frusta). Returns
+    (eye f32[3], dirs f32[3, H, W]) with unit-length dirs."""
+    w = int(meta.window_dims[0])
+    h = int(meta.window_dims[1])
+    inv_vp = jnp.linalg.inv(meta.projection @ meta.view)
+    ndc_x = (jnp.arange(w, dtype=jnp.float32) + 0.5) / w * 2.0 - 1.0
+    ndc_y = 1.0 - (jnp.arange(h, dtype=jnp.float32) + 0.5) / h * 2.0
+    gx, gy = jnp.meshgrid(ndc_x, ndc_y, indexing="xy")
+    ndc = jnp.stack([gx, gy, jnp.full_like(gx, -1.0), jnp.ones_like(gx)])
+    pw = jnp.einsum("ab,bhw->ahw", inv_vp, ndc)
+    near_pt = pw[:3] / pw[3:4]
+    eye = original_eye(meta)
+    dirs = _normalize(near_pt - eye.reshape(3, 1, 1), axis=0)
+    return eye, dirs
+
+
+def depths_to_ndc(vdi: VDI, meta: VDIMetadata) -> VDI:
+    """World-t depths -> NDC z of the generating camera (the reference's
+    storage convention after ConvertToNDC.comp). Empty slots (+inf) map to
+    +inf so emptiness stays recognizable."""
+    _, dirs = rays_from_metadata(meta)
+    p22 = meta.projection[2, 2]
+    p23 = meta.projection[2, 3]
+    dir_ze = jnp.einsum("b,bhw->hw", meta.view[2, :3], dirs)   # < 0 in front
+
+    def conv(t):                                               # t: [K, H, W]
+        ze = dir_ze[None] * t                # eye-space z, negative in front
+        # ndc_z = (p22*ze + p23) / (-ze)
+        ndc = -(p22 + p23 / jnp.where(ze == 0, -1e-20, ze))
+        return jnp.where(jnp.isfinite(t), ndc, jnp.inf)
+
+    start = conv(vdi.depth[:, 0])
+    end = conv(vdi.depth[:, 1])
+    return VDI(vdi.color, jnp.stack([start, end], axis=1))
+
+
+def depths_from_ndc(vdi_ndc: VDI, meta: VDIMetadata) -> VDI:
+    """Inverse of `depths_to_ndc`: NDC-z depths -> world ray parameter t
+    (the framework's internal convention)."""
+    _, dirs = rays_from_metadata(meta)
+    p22 = meta.projection[2, 2]
+    p23 = meta.projection[2, 3]
+    dir_ze = jnp.einsum("b,bhw->hw", meta.view[2, :3], dirs)   # < 0
+
+    def conv(ndc):
+        ze = -p23 / (p22 + ndc)          # eye-space z (negative in front)
+        t = ze / dir_ze[None]
+        return jnp.where(jnp.isfinite(ndc), t, jnp.inf)
+
+    start = conv(vdi_ndc.depth[:, 0])
+    end = conv(vdi_ndc.depth[:, 1])
+    return VDI(vdi_ndc.color, jnp.stack([start, end], axis=1))
+
+
+# ------------------------------------------------------ reference layouts
+
+
+def pack_reference_layout(vdi: VDI) -> Tuple[np.ndarray, np.ndarray]:
+    """Framework VDI -> the reference's texture memory layouts: color
+    rgba32f ``[K, H, W, 4]`` and depth r32f ``[2K, H, W]`` with start/end
+    interleaved per supersegment (OutputSubVDIColor/OutputSubVDIDepth,
+    reference DistributedVolumes.kt:331-368; VDIGenerator.comp:204-226).
+    Empty slots are zero-filled as the generator does (:553-590)."""
+    color = np.moveaxis(np.asarray(vdi.color), 1, -1)          # [K, H, W, 4]
+    depth = np.asarray(vdi.depth)                              # [K, 2, H, W]
+    live = np.isfinite(depth[:, 0])
+    color = np.where(live[..., None], color, 0.0).astype(np.float32)
+    d = np.where(live[:, None], depth, 0.0).astype(np.float32)
+    k, _, h, w = d.shape
+    interleaved = d.reshape(2 * k, h, w)                       # start,end,...
+    return color, interleaved
+
+
+def unpack_reference_layout(color_khw4: np.ndarray,
+                            depth_2khw: np.ndarray) -> VDI:
+    """Inverse of `pack_reference_layout`. Slots with zero alpha AND zero
+    depth extent are treated as empty (depth -> +inf)."""
+    color = jnp.asarray(np.moveaxis(color_khw4, -1, 1), jnp.float32)
+    k2, h, w = depth_2khw.shape
+    d = np.asarray(depth_2khw, np.float32).reshape(k2 // 2, 2, h, w)
+    empty = (np.asarray(color_khw4)[..., 3] <= 0.0) & (d[:, 1] <= d[:, 0])
+    d = np.where(empty[:, None], np.inf, d)
+    return VDI(color, jnp.asarray(d))
+
+
+# ------------------------------------------------------------- validation
+
+
+def validate_vdi(vdi: VDI, ndc: bool = False,
+                 gap_eps: float = 1e-4) -> Dict[str, int]:
+    """Host-side structural checks (≅ the in-shader assertions,
+    ConvertToNDC.comp:155-157, 197-208): per live slot end >= start,
+    consecutive live slots depth-sorted and non-overlapping, alpha in
+    [0, 1], and (ndc mode) depths within [-1, 1]. Returns violation
+    counts; all zeros = valid."""
+    color = np.asarray(vdi.color)
+    depth = np.asarray(vdi.depth)
+    start, end = depth[:, 0], depth[:, 1]
+    live = np.isfinite(start)
+    a = color[:, 3]
+
+    rep: Dict[str, int] = {}
+    rep["inverted_extent"] = int(np.sum(live & (end < start)))
+    overlap = 0
+    unsorted = 0
+    for s in range(vdi.k - 1):
+        both = live[s] & live[s + 1]
+        overlap += int(np.sum(both & (start[s + 1] < end[s] - gap_eps)))
+        unsorted += int(np.sum(both & (start[s + 1] < start[s])))
+    rep["overlapping"] = overlap
+    rep["unsorted"] = unsorted
+    rep["alpha_out_of_range"] = int(np.sum((a < -1e-6) | (a > 1.0 + 1e-6)))
+    rep["dead_slot_after_live"] = int(np.sum(~live[:-1] & live[1:]))
+    if ndc:
+        rep["ndc_out_of_range"] = int(np.sum(
+            live & ((start < -1.0 - 1e-4) | (end > 1.0 + 1e-4))))
+    rep["live_slots"] = int(np.sum(live))
+    return rep

@@ -1,0 +1,104 @@
+"""Tests for VDI depth-convention conversion (ops/vdi_convert.py):
+world-t ↔ NDC-z round-trips, ray reconstruction from metadata (pinhole and
+off-axis), reference texture layout pack/unpack, and validation."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
+from scenery_insitu_tpu.core.camera import Camera, pixel_rays
+from scenery_insitu_tpu.core.transfer import for_dataset
+from scenery_insitu_tpu.core.volume import procedural_volume
+from scenery_insitu_tpu.ops import slicer, vdi_convert as vc
+from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+
+
+@pytest.fixture(scope="module")
+def gathered():
+    vol = procedural_volume(32, kind="blobs", seed=5)
+    tf = for_dataset("procedural")
+    cam = Camera.create((0.2, 0.5, 2.6), fov_y_deg=45.0, near=0.4, far=10.0)
+    vdi, meta = generate_vdi(vol, tf, cam, 48, 40,
+                             VDIConfig(max_supersegments=8, adaptive_iters=3),
+                             max_steps=96)
+    return vol, tf, cam, vdi, meta
+
+
+@pytest.fixture(scope="module")
+def sliced():
+    vol = procedural_volume(32, kind="blobs", seed=5)
+    tf = for_dataset("procedural")
+    cam = Camera.create((0.2, 0.5, 2.6), fov_y_deg=45.0, near=0.4, far=10.0)
+    spec = slicer.make_spec(cam, vol.data.shape,
+                            SliceMarchConfig(matmul_dtype="f32"))
+    vdi, meta, _ = slicer.generate_vdi_mxu(
+        vol, tf, cam, spec, VDIConfig(max_supersegments=8, adaptive_iters=3))
+    return vdi, meta
+
+
+def test_rays_from_metadata_match_pixel_rays(gathered):
+    _, _, cam, _, meta = gathered
+    eye_m, dirs_m = vc.rays_from_metadata(meta)
+    eye_c, dirs_c = pixel_rays(cam, int(meta.window_dims[0]),
+                               int(meta.window_dims[1]))
+    assert np.allclose(np.asarray(eye_m), np.asarray(eye_c), atol=1e-4)
+    assert np.allclose(np.asarray(dirs_m), np.asarray(dirs_c), atol=1e-4)
+
+
+@pytest.mark.parametrize("fixture", ["gathered", "sliced"])
+def test_ndc_roundtrip(fixture, request):
+    item = request.getfixturevalue(fixture)
+    vdi, meta = (item[3], item[4]) if len(item) == 5 else item
+    ndc = vc.depths_to_ndc(vdi, meta)
+    live = np.isfinite(np.asarray(vdi.depth[:, 0]))
+    s = np.asarray(ndc.depth[:, 0])[live]
+    # NDC z of content must lie in the canonical [-1, 1]
+    assert (s >= -1.0 - 1e-3).all() and (s <= 1.0 + 1e-3).all()
+    # and be front-to-back monotone increasing vs world t
+    back = vc.depths_from_ndc(ndc, meta)
+    t0 = np.asarray(vdi.depth)[:, :, live.any(axis=0)]
+    t1 = np.asarray(back.depth)[:, :, live.any(axis=0)]
+    both = np.isfinite(t0)
+    assert np.allclose(t0[both], t1[both], rtol=1e-3, atol=1e-3)
+
+
+def test_reference_layout_roundtrip(gathered):
+    vdi = gathered[3]
+    color, depth = vc.pack_reference_layout(vdi)
+    k = vdi.k
+    assert color.shape == (k, vdi.height, vdi.width, 4)
+    assert depth.shape == (2 * k, vdi.height, vdi.width)
+    back = vc.unpack_reference_layout(color, depth)
+    live = np.isfinite(np.asarray(vdi.depth[:, 0]))
+    assert np.allclose(np.asarray(back.color), np.asarray(vdi.color))
+    assert np.allclose(np.asarray(back.depth[:, 0])[live],
+                       np.asarray(vdi.depth[:, 0])[live])
+    assert np.allclose(np.asarray(back.depth[:, 1])[live],
+                       np.asarray(vdi.depth[:, 1])[live])
+    # empties stay empty
+    assert np.isinf(np.asarray(back.depth[:, 0])[~live]).all()
+
+
+def test_validate_vdi_clean(gathered, sliced):
+    for vdi, meta in [(gathered[3], gathered[4]), sliced]:
+        rep = vc.validate_vdi(vdi)
+        assert rep["live_slots"] > 0
+        for key in ("inverted_extent", "overlapping", "unsorted",
+                    "alpha_out_of_range"):
+            assert rep[key] == 0, (key, rep)
+        ndc = vc.depths_to_ndc(vdi, meta)
+        rep2 = vc.validate_vdi(ndc, ndc=True)
+        assert rep2["ndc_out_of_range"] == 0, rep2
+
+
+def test_validate_vdi_detects_corruption(gathered):
+    vdi = gathered[3]
+    bad_depth = np.asarray(vdi.depth).copy()
+    live = np.isfinite(bad_depth[:, 0])
+    # invert one live slot's extent
+    k, h, w = np.argwhere(live)[0]
+    bad_depth[k, 1, h, w] = bad_depth[k, 0, h, w] - 1.0
+    from scenery_insitu_tpu.core.vdi import VDI
+    rep = vc.validate_vdi(VDI(vdi.color, jnp.asarray(bad_depth)))
+    assert rep["inverted_extent"] >= 1

@@ -20,13 +20,16 @@ from __future__ import annotations
 import argparse
 import os
 import socket
+import sys
 import threading
 import time
 import uuid
 
 import numpy as np
 
-from scenery_insitu_tpu.ingest.shm import ShmConsumer, ShmProducer
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scenery_insitu_tpu.ingest.shm import ShmConsumer, ShmProducer  # noqa: E402
 
 
 def bench_size(nfloats: int, iters: int, device: bool):
@@ -96,6 +99,7 @@ def bench_mmap(nfloats: int, iters: int) -> float:
             view[:] = frame                      # producer write
             _ = view.copy()                      # consumer read
         dt = (time.perf_counter() - t0) / iters
+        del view                # drop the exported buffer so close() works
         mm.close()
         return dt
     finally:
@@ -125,7 +129,10 @@ def bench_fifo(nfloats: int, iters: int) -> float:
             for _ in range(iters):
                 got = f.read(nbytes)
                 while len(got) < nbytes:
-                    got += f.read(nbytes - len(got))
+                    chunk = f.read(nbytes - len(got))
+                    if not chunk:
+                        raise IOError("producer closed early")
+                    got += chunk
         dt = (time.perf_counter() - t0) / iters
         th.join(timeout=10)
         return dt

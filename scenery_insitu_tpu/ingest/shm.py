@@ -31,9 +31,22 @@ DEMO_PRODUCER = os.path.join(_NATIVE_DIR, "build", "demo_producer")
 _lib = None
 
 
+def _sources_mtime() -> float:
+    newest = 0.0
+    for name in os.listdir(_NATIVE_DIR):
+        if name.endswith((".cpp", ".h", ".hpp")) or name == "Makefile":
+            newest = max(newest, os.path.getmtime(
+                os.path.join(_NATIVE_DIR, name)))
+    return newest
+
+
 def ensure_built(force: bool = False) -> str:
-    """Build the native library on first use (g++ is part of the image)."""
-    if force or not os.path.exists(_LIB_PATH):
+    """Build the native library on first use, and REBUILD when any source
+    is newer than the binary — a stale .so from an older checkout otherwise
+    fails at ctypes symbol lookup with an opaque 'undefined symbol'."""
+    stale = (not os.path.exists(_LIB_PATH)
+             or os.path.getmtime(_LIB_PATH) < _sources_mtime())
+    if force or stale:
         subprocess.run(["make", "-C", _NATIVE_DIR],
                        check=True, capture_output=True)
     return _LIB_PATH
@@ -86,8 +99,16 @@ def channel_stats(channel: str) -> dict:
     if not h:
         raise FileNotFoundError(f"no shm channel {channel!r}")
     try:
-        buf = (ctypes.c_uint64 * 32)()
-        n = lib.shm_channel_stats(h, buf, 32)
+        # size the buffer from the channel's actual slot count instead of a
+        # fixed 32 (which silently relied on kMaxSlots=8 in the C++ side)
+        nslots_c = int(lib.shm_channel_nslots(h))
+        need = 7 + 2 * nslots_c
+        buf = (ctypes.c_uint64 * need)()
+        n = lib.shm_channel_stats(h, buf, need)
+        if n == 0:
+            raise OSError(
+                f"shm_channel_stats returned no data for {channel!r} "
+                f"(buffer {need} u64, nslots {nslots_c})")
         vals = list(buf[:n])
         nslots = int(vals[0])
         return {

@@ -69,6 +69,10 @@ class SliceMarchConfig:
     matmul_dtype: str = "bf16"
     # Minimum eye-depth ratio; slices closer to the eye plane are dropped.
     s_floor: float = 1e-3
+    # Empty-space skipping: skip slice chunks whose value range maps to
+    # zero alpha (≅ the reference's OctreeCells occupancy acceleration,
+    # VDIGenerator.comp:232-254 — here consumed, per-frame, by the march).
+    skip_empty: bool = True
 
 
 @dataclass(frozen=True)

@@ -64,7 +64,7 @@ def grayscott_vdi_frame_step(width: int, height: int,
             tuple(grid_shape), slicer_cfg, axis_sign=axis_sign)
 
     def frame_step(u, v, eye):
-        state = gs.multi_step(gs.GrayScott(u, v, params), sim_steps)
+        state = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
         vol = Volume.centered(state.field, extent=2.0)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
         if engine == "mxu":

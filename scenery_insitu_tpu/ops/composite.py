@@ -27,13 +27,18 @@ from scenery_insitu_tpu.ops import supersegments as ss
 
 def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
                    cfg: Optional[CompositeConfig] = None,
-                   gap_eps: float = 1e-4) -> VDI:
+                   gap_eps: float = 1e-4,
+                   assume_sorted: Optional[bool] = None) -> VDI:
     """colors f32[N, K, 4, H, W], depths f32[N, K, 2, H, W] -> VDI[K_out].
 
     Segments from different ranks are assumed depth-disjoint per pixel up to
     interpolation overlap at domain boundaries (the sort-last invariant the
     reference also relies on); overlapping segments are composited in
     start-depth order.
+
+    ``assume_sorted``: skip the per-pixel depth sort + stale-color masking.
+    Defaults to True for N == 1, whose single VDI comes out of generation
+    already front-to-back ordered with zeroed empty slots.
     """
     cfg = cfg or CompositeConfig()
     n, k, _, h, w = colors.shape
@@ -41,15 +46,32 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
     flat_c = colors.reshape(nk, 4, h, w)
     flat_d = depths.reshape(nk, 2, h, w)
 
-    # Empty slots carry +inf start so they sort to the back.
-    order = jnp.argsort(flat_d[:, 0], axis=0)              # [NK, H, W]
-    sc = jnp.take_along_axis(flat_c, order[:, None], axis=0)
-    sd = jnp.take_along_axis(flat_d, order[:, None], axis=0)
-    # Mask non-live slots to zero alpha (they may carry stale colors).
-    live = jnp.isfinite(sd[:, 0])
-    sc = jnp.where(live[:, None], sc, 0.0)
+    if assume_sorted is None:
+        assume_sorted = (n == 1)
+    if assume_sorted:
+        sc, sd = flat_c, flat_d
+    else:
+        # Empty slots carry +inf start so they sort to the back.
+        order = jnp.argsort(flat_d[:, 0], axis=0)          # [NK, H, W]
+        sc = jnp.take_along_axis(flat_c, order[:, None], axis=0)
+        sd = jnp.take_along_axis(flat_d, order[:, None], axis=0)
+        # Mask non-live slots to zero alpha (they may carry stale colors).
+        live = jnp.isfinite(sd[:, 0])
+        sc = jnp.where(live[:, None], sc, 0.0)
 
     k_out = cfg.max_output_supersegments
+
+    backend = cfg.backend
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+
+    if backend == "pallas":
+        # fully fused: the adaptive threshold search runs inside the kernel
+        from scenery_insitu_tpu.ops.pallas_composite import resegment_sorted
+        color, depth = resegment_sorted(
+            sc, sd, None, k_out, gap_eps,
+            adaptive_iters=cfg.adaptive_iters if cfg.adaptive else 0)
+        return VDI(color, depth)
 
     if cfg.adaptive:
         def count_fn(thr):
@@ -62,14 +84,6 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
                                           cfg.adaptive_iters, h, w)
     else:
         threshold = jnp.zeros((h, w), jnp.float32)
-
-    backend = cfg.backend
-    if backend == "auto":
-        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
-    if backend == "pallas":
-        from scenery_insitu_tpu.ops.pallas_composite import resegment_sorted
-        color, depth = resegment_sorted(sc, sd, threshold, k_out, gap_eps)
-        return VDI(color, depth)
 
     def body(st, item):
         c, d = item

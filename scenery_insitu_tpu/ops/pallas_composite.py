@@ -4,19 +4,18 @@ VDICompositor.comp:58-91,209-459).
 
 The XLA path (ops.composite.composite_vdis) runs the supersegment state
 machine as a ``lax.scan`` over the N*K depth-sorted slots with full-frame
-[H, W] state — every scan iteration round-trips the state through HBM. This
-kernel fuses the whole fold over a (8, 128)-pixel tile held in VMEM: the
-stream axis becomes an in-kernel ``fori_loop`` whose carry lives in
-registers/VMEM, so the *write pass* reads each slab from HBM exactly once
-and no intermediate state ever spills. (With ``CompositeConfig.adaptive``
-the preceding threshold search still runs ``adaptive_iters`` counting
-scans through XLA — fusing those into the same tile scheme is the next
-step for this kernel.)
+[H, W] state — every scan iteration round-trips the state through HBM, and
+with ``CompositeConfig.adaptive`` the threshold binary search multiplies
+that by ``adaptive_iters`` more counting scans. This kernel fuses the
+WHOLE composite — the adaptive search's counting passes AND the write pass
+— over a (8, 128)-pixel tile held in VMEM: the slab stream is read from
+HBM exactly once per tile, every counting/write iteration runs on
+VMEM-resident state, and nothing intermediate ever spills.
 
-The kernel body calls the very same ``supersegments.push``/``finalize``
-functions the XLA path uses — one implementation of the merge semantics,
-two schedules — so the parity test (tests/test_pallas.py) can assert exact
-equality.
+The kernel body calls the very same ``supersegments.push``/``push_count``/
+``finalize``/``adaptive_threshold``-equivalent logic the XLA path uses —
+one implementation of the merge semantics, two schedules — so the parity
+test (tests/test_pallas.py) can assert exact equality.
 
 On CPU (tests, the 8-device virtual mesh) the kernel runs in interpret
 mode automatically; on TPU it compiles with Mosaic.
@@ -45,16 +44,55 @@ def _should_interpret() -> bool:
 
 def _kernel(sc_ref, sd_ref, thr_ref, color_ref, depth_ref,
             seg_ref, ends_ref, prev_ref, flags_ref, k_ref,
-            *, k_out: int, gap_eps: float):
+            *, k_out: int, gap_eps: float, adaptive_iters: int,
+            thr_max: float):
     # State lives in VMEM scratch, not in the fori_loop carry: Mosaic cannot
     # legalize an scf.for with dozens of carried vectors (one per [th, tw]
     # plane of SegState), and bool carries are illegal outright. The loop
-    # carries nothing; each iteration loads SegState from the scratch refs,
-    # runs the shared supersegments.push, and stores it back.
+    # carries nothing; each iteration loads state from the scratch refs,
+    # runs the shared supersegments fold, and stores it back.
     nk = sc_ref.shape[0]
     th, tw = thr_ref.shape
-    thr = thr_ref[...]
 
+    # ------------------------------------------- adaptive threshold search
+    # (≅ ss.adaptive_threshold, but the counting marches run on the VMEM-
+    # resident slab tile instead of re-scanning HBM adaptive_iters times)
+    if adaptive_iters > 0:
+        def count_pass(mid):
+            # CountState in scratch: k_ref=count, prev_ref=prev_rgb,
+            # flags_ref[1]=prev_empty, ends_ref[0]=prev_end
+            k_ref[...] = jnp.zeros((th, tw), jnp.int32)
+            prev_ref[...] = jnp.zeros((3, th, tw), jnp.float32)
+            flags_ref[1] = jnp.ones((th, tw), jnp.float32)
+            ends_ref[0] = jnp.full((th, tw), -jnp.inf, jnp.float32)
+
+            def body(i, _):
+                st = ss.CountState(count=k_ref[...], prev_rgb=prev_ref[...],
+                                   prev_empty=flags_ref[1] > 0.5,
+                                   prev_end=ends_ref[0])
+                st = ss.push_count(st, mid, sc_ref[i], sd_ref[i, 0],
+                                   sd_ref[i, 1], gap_eps)
+                k_ref[...] = st.count
+                prev_ref[...] = st.prev_rgb
+                flags_ref[1] = st.prev_empty.astype(jnp.float32)
+                ends_ref[0] = st.prev_end
+                return 0
+
+            jax.lax.fori_loop(0, nk, body, 0)
+            return k_ref[...]
+
+        lo = jnp.zeros((th, tw), jnp.float32)
+        hi = jnp.full((th, tw), thr_max, jnp.float32)
+        for _ in range(adaptive_iters):
+            mid = 0.5 * (lo + hi)
+            too_many = count_pass(mid) > k_out
+            lo = jnp.where(too_many, mid, lo)
+            hi = jnp.where(too_many, hi, mid)
+        thr = hi
+    else:
+        thr = thr_ref[...]
+
+    # ---------------------------------------------------------- write pass
     color_ref[...] = jnp.zeros_like(color_ref)
     depth_ref[...] = jnp.full_like(depth_ref, jnp.inf)
     seg_ref[...] = jnp.zeros_like(seg_ref)
@@ -102,20 +140,27 @@ def _kernel(sc_ref, sd_ref, thr_ref, color_ref, depth_ref,
     depth_ref[...] = depth
 
 
-def resegment_sorted(sc: jnp.ndarray, sd: jnp.ndarray, threshold: jnp.ndarray,
-                     k_out: int, gap_eps: float = 1e-4,
-                     interpret: Optional[bool] = None
+def resegment_sorted(sc: jnp.ndarray, sd: jnp.ndarray,
+                     threshold: Optional[jnp.ndarray], k_out: int,
+                     gap_eps: float = 1e-4,
+                     interpret: Optional[bool] = None,
+                     adaptive_iters: int = 0, thr_max: float = 2.0
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fold a depth-sorted slab stream into K_out supersegments per pixel.
 
     sc f32[NK, 4, H, W] premultiplied (empty slots alpha 0),
-    sd f32[NK, 2, H, W] (start, end; +inf when empty), threshold f32[H, W].
-    Returns (color f32[K_out, 4, H, W], depth f32[K_out, 2, H, W]) —
-    exactly what the scan in composite_vdis produces.
+    sd f32[NK, 2, H, W] (start, end; +inf when empty).
+    ``adaptive_iters > 0`` runs the per-pixel threshold binary search
+    inside the kernel (``threshold`` may be None); otherwise ``threshold``
+    f32[H, W] is used as-is. Returns (color f32[K_out, 4, H, W], depth
+    f32[K_out, 2, H, W]) — exactly what the XLA scans in composite_vdis
+    produce.
     """
     nk, _, h, w = sc.shape
     if interpret is None:
         interpret = _should_interpret()
+    if threshold is None:
+        threshold = jnp.zeros((h, w), jnp.float32)
 
     # pad pixels to tile multiples; padded pixels see only empty slabs
     ph = (-h) % TILE_H
@@ -128,7 +173,9 @@ def resegment_sorted(sc: jnp.ndarray, sd: jnp.ndarray, threshold: jnp.ndarray,
     hp, wp = h + ph, w + pw
     grid = (hp // TILE_H, wp // TILE_W)
 
-    kernel = functools.partial(_kernel, k_out=k_out, gap_eps=gap_eps)
+    kernel = functools.partial(_kernel, k_out=k_out, gap_eps=gap_eps,
+                               adaptive_iters=adaptive_iters,
+                               thr_max=thr_max)
     color, depth = pl.pallas_call(
         kernel,
         grid=grid,

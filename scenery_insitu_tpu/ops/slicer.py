@@ -79,6 +79,7 @@ class AxisSpec:
     chunk: int = 16           # slices folded per scan step
     matmul_dtype: str = "bf16"   # resampling matmul operand dtype
     s_floor: float = 1e-3     # min depth ratio: slices closer are dropped
+    skip_empty: bool = True   # chunk_occupancy-based empty-space skipping
 
     @property
     def u_axis(self) -> int:
@@ -131,7 +132,7 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     return AxisSpec(axis=axis, sign=sign,
                     ni=rnd(dims_xyz[u_axis]), nj=rnd(dims_xyz[v_axis]),
                     chunk=cfg.chunk, matmul_dtype=dtype,
-                    s_floor=cfg.s_floor)
+                    s_floor=cfg.s_floor, skip_empty=cfg.skip_empty)
 
 
 class AxisCamera(NamedTuple):
@@ -284,15 +285,49 @@ def _interp_matrix(pos: jnp.ndarray, origin, spacing, n: int,
     return w * valid[..., None].astype(jnp.float32)
 
 
+def chunk_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
+                    alpha_eps: float = 1e-5) -> jnp.ndarray:
+    """bool[nchunks]: can the slab of ``spec.chunk`` slices contribute any
+    opacity? The TPU-native occupancy structure (≅ the reference's
+    OctreeCells grid, VDIGenerator.comp:232-254 + GridCellsToZero.comp —
+    but computed in one cheap reduction pass per frame instead of
+    atomic-add during the march, and consumed by `slice_march` to skip
+    whole chunks). Conservative: in-plane bilinear resampling keeps values
+    inside each slice's [min, max], so a slab whose value range maps to
+    zero alpha everywhere (``tf.max_alpha_in``) is provably invisible."""
+    volp = permute_volume(vol, spec)
+    s_total = volp.shape[0]
+    c = spec.chunk
+    nchunks = -(-s_total // c)
+    if nchunks * c != s_total:
+        pad = nchunks * c - s_total
+        volp = jnp.concatenate(
+            [volp, jnp.zeros((pad,) + volp.shape[1:], volp.dtype)], axis=0)
+    slabs = volp.reshape(nchunks, -1)
+    lo = jnp.clip(jnp.min(slabs, axis=1), 0.0, 1.0)
+    hi = jnp.clip(jnp.max(slabs, axis=1), 0.0, 1.0)
+    return tf.max_alpha_in(lo, hi) > alpha_eps
+
+
 def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 spec: AxisSpec, consume: Callable, carry0,
-                u_bounds=None, v_bounds=None, step_scale: float = 1.0):
+                u_bounds=None, v_bounds=None, step_scale: float = 1.0,
+                occupancy: Optional[jnp.ndarray] = None,
+                early_stop: Optional[Callable] = None):
     """The chunked slice march. Calls ``consume(carry, rgba [C,4,Nj,Ni],
     t0 [C,Nj,Ni], t1 [C,Nj,Ni]) -> carry`` for each chunk of slices, front
     to back, and returns the final carry.
 
     rgba is premultiplied, already opacity-corrected for the per-ray
     inter-slice path length, and zero outside the volume/ownership bounds.
+
+    ``occupancy`` (bool[nchunks], from `chunk_occupancy`) skips the
+    resampling matmuls and fold for provably-empty chunks; the skipped
+    branch still feeds ONE all-empty sample so stream-gap semantics
+    (supersegment closing on empty) are identical to the full march.
+    ``early_stop(carry) -> bool[]`` additionally skips every chunk after
+    the predicate turns true (alpha-saturation early-out, ≅ the
+    reference's early exit in AccumulatePlainImage.comp:8-13).
     """
     volp = permute_volume(vol, spec)
     s_total = volp.shape[0]
@@ -321,7 +356,7 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                          vol.origin[a] + 0.5 * vol.spacing[a],
                          vol.origin[a] + (now_ - 0.5) * vol.spacing[a])
 
-    def body(carry, ci):
+    def work(carry, ci):
         ks = ci * c + jnp.arange(c, dtype=jnp.float32)     # [C]
         wk = local_w0 + ks * axcam.dwm
         sk = jnp.float32(spec.sign) * (wk - ew) / axcam.zp   # depth ratios
@@ -350,7 +385,26 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 
         t0 = sk[:, None, None] * length[None]
         t1 = (sk + ds)[:, None, None] * length[None]
-        return consume(carry, rgba, t0, t1), None
+        return consume(carry, rgba, t0, t1)
+
+    def skip(carry, ci):
+        # one explicit empty sample: closes any open supersegment exactly
+        # like the stream of empties the full march would have produced
+        empty = jnp.zeros((1, 4, spec.nj, spec.ni), jnp.float32)
+        s0 = jnp.float32(spec.sign) * (local_w0 + ci * c * axcam.dwm - ew) \
+            / axcam.zp
+        t = (s0 * length)[None]                            # [1, Nj, Ni]
+        return consume(carry, empty, t, t)
+
+    gated = occupancy is not None or early_stop is not None
+
+    def body(carry, ci):
+        if not gated:
+            return work(carry, ci), None
+        occupied = jnp.bool_(True) if occupancy is None else occupancy[ci]
+        if early_stop is not None:
+            occupied &= ~early_stop(carry)
+        return jax.lax.cond(occupied, work, skip, carry, ci), None
 
     carry, _ = jax.lax.scan(body, carry0, jnp.arange(nchunks))
     return carry
@@ -365,7 +419,8 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                   step_scale: float = 1.0) -> RaycastOutput:
     """Front-to-back alpha-under accumulation on the intermediate grid
     (≅ VolumeRaycaster.comp, but slice-order). Background-free premultiplied
-    image + first-hit depth (ray parameter; +inf where empty)."""
+    image + first-hit depth (ray parameter; +inf where empty). Skips
+    provably-empty chunks and stops once every pixel is alpha-saturated."""
 
     def consume(carry, rgba, t0, t1):
         acc, first_t = carry
@@ -379,8 +434,11 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 
     acc0 = jnp.zeros((4, spec.nj, spec.ni), jnp.float32)
     t0 = jnp.full((spec.nj, spec.ni), jnp.inf, jnp.float32)
-    acc, first_t = slice_march(vol, tf, axcam, spec, consume, (acc0, t0),
-                               u_bounds, v_bounds, step_scale)
+    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
+    acc, first_t = slice_march(
+        vol, tf, axcam, spec, consume, (acc0, t0),
+        u_bounds, v_bounds, step_scale, occupancy=occ,
+        early_stop=lambda c: jnp.all(c[0][3] >= early_exit_alpha))
     return RaycastOutput(acc, first_t)
 
 
@@ -491,8 +549,11 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     nj, ni = spec.nj, spec.ni
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
 
+    # one occupancy pass shared by every counting + writing march
+    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
     march = lambda consume, carry0: slice_march(
-        vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds)
+        vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
+        occupancy=occ)
 
     if cfg.adaptive:
         def count_fn(thr):

@@ -179,3 +179,75 @@ def test_warp_roundtrip_identity(vol):
     # u increases to the right, v decreases downward in both spaces
     assert o[0, 24, 40] > o[0, 24, 8]
     assert o[1, 40, 24] > o[1, 8, 24]
+
+
+# ------------------------------------------------- occupancy acceleration
+
+
+def test_occupancy_skip_is_exact(vol, tf):
+    """Empty-space skipping must not change a single output value: the
+    skipped branch feeds one explicit empty sample, reproducing the gap
+    semantics of the full march bit-for-bit."""
+    cam = Camera.create((0.3, 0.5, 2.8), fov_y_deg=45.0, near=0.3, far=10.0)
+    spec_on = slicer.make_spec(cam, vol.data.shape, F32)
+    spec_off = slicer.make_spec(
+        cam, vol.data.shape,
+        SliceMarchConfig(matmul_dtype="f32", scale=1.5, skip_empty=False))
+    cfg = VDIConfig(max_supersegments=6, adaptive_iters=2)
+    vdi_on, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec_on, cfg)
+    vdi_off, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec_off, cfg)
+    np.testing.assert_allclose(np.asarray(vdi_on.color),
+                               np.asarray(vdi_off.color), atol=1e-6)
+    d_on = np.nan_to_num(np.asarray(vdi_on.depth), posinf=1e9)
+    d_off = np.nan_to_num(np.asarray(vdi_off.depth), posinf=1e9)
+    np.testing.assert_allclose(d_on, d_off, atol=1e-5)
+
+
+def test_occupancy_flags_conservative(tf):
+    """Every chunk flagged empty must truly contribute zero alpha."""
+    data = jnp.zeros((64, 16, 16), jnp.float32)
+    data = data.at[24:40].set(0.9)         # one occupied band mid-volume
+    v = Volume.centered(data, extent=2.0)
+    cam = Camera.create((0.0, 0.2, 3.0), fov_y_deg=45.0)
+    spec = slicer.make_spec(cam, v.data.shape, F32)
+    occ = np.asarray(slicer.chunk_occupancy(v, tf, spec))
+    assert occ.sum() < occ.size            # something was skippable
+    # the occupied band (slices 24..40 of 64) must be flagged occupied
+    c = spec.chunk
+    for ci in range(occ.size):
+        sl = slice(ci * c, (ci + 1) * c)
+        band = np.asarray(v.data[sl]) if spec.axis == 2 else None
+        if band is not None and band.max() > 0.5:
+            assert occ[ci]
+
+
+def test_render_slices_early_stop_exact(tf):
+    """Saturation early-out must not change the image (gated pixels stop
+    accumulating anyway)."""
+    data = jnp.full((48, 48, 48), 0.95, jnp.float32)   # dense, saturates fast
+    v = Volume.centered(data, extent=2.0)
+    cam = Camera.create((0.0, 0.1, 3.0), fov_y_deg=45.0)
+    spec = slicer.make_spec(cam, v.data.shape, F32)
+    axcam = slicer.make_axis_camera(v, cam, spec)
+    out_fast = slicer.render_slices(v, tf, axcam, spec)
+    # reference: no occupancy, no early stop
+    spec_off = slicer.make_spec(
+        cam, v.data.shape,
+        SliceMarchConfig(matmul_dtype="f32", scale=1.5, skip_empty=False))
+    axcam2 = slicer.make_axis_camera(v, cam, spec_off)
+
+    def consume(carry, rgba, t0, t1):
+        acc, first_t = carry
+        for i in range(rgba.shape[0]):
+            gate = (acc[3] < 0.999).astype(jnp.float32)
+            src = rgba[i] * gate[None]
+            acc = acc + (1.0 - acc[3:4]) * src
+            first_t = jnp.where((first_t == jnp.inf) & (src[3] > 1e-4),
+                                t0[i], first_t)
+        return acc, first_t
+
+    acc0 = jnp.zeros((4, spec_off.nj, spec_off.ni), jnp.float32)
+    ft0 = jnp.full((spec_off.nj, spec_off.ni), jnp.inf, jnp.float32)
+    acc, _ = slicer.slice_march(v, tf, axcam2, spec_off, consume, (acc0, ft0))
+    np.testing.assert_allclose(np.asarray(out_fast.image), np.asarray(acc),
+                               atol=1e-5)

@@ -413,6 +413,43 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 # ------------------------------------------------------- plain-image render
 
 
+def hittable_mask(vol: Volume, axcam: AxisCamera, spec: AxisSpec
+                  ) -> jnp.ndarray:
+    """bool[Nj, Ni]: can this intermediate-grid pixel's ray intersect the
+    volume AABB at any marched depth? The intermediate grid covers the
+    whole projected footprint plus margins, so its edge pixels never
+    accumulate alpha — any all-pixels predicate (saturation early-out)
+    must ignore them. Per pixel, pos_u(s) = eu + (u_i - eu)·s lies in the
+    volume's u extent for an interval of depth ratios s; the pixel is
+    hittable iff the u and v intervals overlap somewhere in s > 0
+    (conservative: the actual march range is a subset)."""
+    a, ua, va = spec.axis, spec.u_axis, spec.v_axis
+
+    def axis_interval(grid, e, lo, hi):
+        d = grid - e
+        big = jnp.float32(1e30)
+        s0 = jnp.where(d > 0, (lo - e) / jnp.where(d == 0, 1.0, d),
+                       jnp.where(d < 0, (hi - e) / jnp.where(d == 0, 1.0, d),
+                                 jnp.where((e >= lo) & (e <= hi), 0.0, big)))
+        s1 = jnp.where(d > 0, (hi - e) / jnp.where(d == 0, 1.0, d),
+                       jnp.where(d < 0, (lo - e) / jnp.where(d == 0, 1.0, d),
+                                 jnp.where((e >= lo) & (e <= hi), big, -big)))
+        return s0, s1
+
+    u0, u1 = axis_interval(axcam.u_grid, axcam.eye_u,
+                           vol.world_min[ua], vol.world_max[ua])
+    v0, v1 = axis_interval(axcam.v_grid, axcam.eye_v,
+                           vol.world_min[va], vol.world_max[va])
+    # the march only visits depth ratios between the volume's w faces
+    sa = jnp.float32(spec.sign) * (vol.world_min[a] - axcam.eye_w) / axcam.zp
+    sb = jnp.float32(spec.sign) * (vol.world_max[a] - axcam.eye_w) / axcam.zp
+    s_lo = jnp.minimum(sa, sb)
+    s_hi = jnp.maximum(sa, sb)
+    lo = jnp.maximum(jnp.maximum(u0[None, :], v0[:, None]), s_lo)
+    hi = jnp.minimum(jnp.minimum(u1[None, :], v1[:, None]), s_hi)
+    return jnp.maximum(lo, 0.0) <= hi
+
+
 def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                   spec: AxisSpec, early_exit_alpha: float = 0.999,
                   u_bounds=None, v_bounds=None,
@@ -420,7 +457,11 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     """Front-to-back alpha-under accumulation on the intermediate grid
     (≅ VolumeRaycaster.comp, but slice-order). Background-free premultiplied
     image + first-hit depth (ray parameter; +inf where empty). Skips
-    provably-empty chunks and stops once every pixel is alpha-saturated."""
+    provably-empty chunks; saturated pixels stop accumulating via the
+    per-pixel gate (≅ AccumulatePlainImage.comp:8-13 — a whole-chunk
+    saturation stop is NOT wired up: silhouette pixels get tapered
+    partial-weight edge samples and never reach the threshold, so an
+    all-pixels predicate can essentially never fire)."""
 
     def consume(carry, rgba, t0, t1):
         acc, first_t = carry
@@ -435,10 +476,8 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     acc0 = jnp.zeros((4, spec.nj, spec.ni), jnp.float32)
     t0 = jnp.full((spec.nj, spec.ni), jnp.inf, jnp.float32)
     occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
-    acc, first_t = slice_march(
-        vol, tf, axcam, spec, consume, (acc0, t0),
-        u_bounds, v_bounds, step_scale, occupancy=occ,
-        early_stop=lambda c: jnp.all(c[0][3] >= early_exit_alpha))
+    acc, first_t = slice_march(vol, tf, axcam, spec, consume, (acc0, t0),
+                               u_bounds, v_bounds, step_scale, occupancy=occ)
     return RaycastOutput(acc, first_t)
 
 

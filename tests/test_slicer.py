@@ -204,21 +204,25 @@ def test_occupancy_skip_is_exact(vol, tf):
 
 
 def test_occupancy_flags_conservative(tf):
-    """Every chunk flagged empty must truly contribute zero alpha."""
+    """Every chunk flagged empty must truly contribute zero alpha — checked
+    in MARCH order (chunk_occupancy chunks the permuted+flipped volume), on
+    an asymmetric band so a flip-indexing regression cannot pass."""
     data = jnp.zeros((64, 16, 16), jnp.float32)
-    data = data.at[24:40].set(0.9)         # one occupied band mid-volume
+    data = data.at[8:24].set(0.9)          # asymmetric occupied band
     v = Volume.centered(data, extent=2.0)
     cam = Camera.create((0.0, 0.2, 3.0), fov_y_deg=45.0)
     spec = slicer.make_spec(cam, v.data.shape, F32)
+    assert spec.axis == 2                  # the camera this test assumes
     occ = np.asarray(slicer.chunk_occupancy(v, tf, spec))
     assert occ.sum() < occ.size            # something was skippable
-    # the occupied band (slices 24..40 of 64) must be flagged occupied
+    volp = np.asarray(slicer.permute_volume(v, spec))   # march layout
     c = spec.chunk
     for ci in range(occ.size):
-        sl = slice(ci * c, (ci + 1) * c)
-        band = np.asarray(v.data[sl]) if spec.axis == 2 else None
-        if band is not None and band.max() > 0.5:
-            assert occ[ci]
+        band = volp[ci * c:(ci + 1) * c]
+        if band.size and band.max() > 0.5:
+            assert occ[ci], f"occupied chunk {ci} flagged empty"
+        if band.size and band.max() < 1e-6:
+            assert not occ[ci], f"empty chunk {ci} flagged occupied"
 
 
 def test_render_slices_early_stop_exact(tf):
@@ -251,3 +255,47 @@ def test_render_slices_early_stop_exact(tf):
     acc, _ = slicer.slice_march(v, tf, axcam2, spec_off, consume, (acc0, ft0))
     np.testing.assert_allclose(np.asarray(out_fast.image), np.asarray(acc),
                                atol=1e-5)
+
+
+def test_hittable_mask_conservative():
+    """Every pixel that accumulates any alpha must be flagged hittable, and
+    the mask must exclude some frustum-margin pixels (it exists so that
+    whole-grid predicates can ignore rays that miss the volume)."""
+    data = jnp.full((48, 48, 48), 0.95, jnp.float32)
+    tf = TransferFunction.ramp(0.0, 0.5, 1.0)
+    v = Volume.centered(data, extent=2.0)
+    cam = Camera.create((0.0, 0.1, 3.0), fov_y_deg=45.0)
+    spec = slicer.make_spec(cam, v.data.shape, F32)
+    axcam = slicer.make_axis_camera(v, cam, spec)
+    out = slicer.render_slices(v, tf, axcam, spec)
+    miss = ~np.asarray(slicer.hittable_mask(v, axcam, spec))
+    hit = np.asarray(out.image[3]) > 1e-4
+    assert not (hit & miss).any()
+    assert miss.any()                      # margins are excluded
+
+
+def test_slice_march_early_stop_mechanism():
+    """The generic early_stop hook must actually skip chunks: a consumer
+    counting processed samples sees fewer once the predicate turns true,
+    while a permanently-false predicate reproduces the full march."""
+    data = jnp.full((64, 16, 16), 0.5, jnp.float32)
+    tf = TransferFunction.ramp(0.0, 0.5, 1.0)
+    v = Volume.centered(data, extent=2.0)
+    cam = Camera.create((0.0, 0.0, 3.0), fov_y_deg=45.0)
+    spec = slicer.make_spec(cam, v.data.shape, F32)
+    axcam = slicer.make_axis_camera(v, cam, spec)
+
+    def consume(carry, rgba, t0, t1):
+        return carry + rgba.shape[0]       # samples seen
+
+    full = slicer.slice_march(v, tf, axcam, spec, consume,
+                              jnp.int32(0),
+                              early_stop=lambda c: jnp.bool_(False))
+    stopped = slicer.slice_march(v, tf, axcam, spec, consume,
+                                 jnp.int32(0),
+                                 early_stop=lambda c: c >= spec.chunk)
+    assert int(full) > int(stopped)
+    # after the first chunk the predicate is true: one full chunk + one
+    # empty sample per remaining chunk
+    nchunks = int(full) // spec.chunk
+    assert int(stopped) == spec.chunk + (nchunks - 1)

@@ -1,0 +1,268 @@
+"""Distributed compositing benchmark — replay stored VDI fixtures through
+the real distribute/composite path (≅ VDICompositingTest.kt:207-330, the
+reference's C++-driven MPI compositing benchmark).
+
+The reference replays stored VDI dumps through ``distributeVDIsForBenchmark``
+(plain MPI all-to-all) or ``distributeVDIsWithVariableLength`` (per-segment
+LZ4 + alltoallv, :251-304), composites on the GPU, and emits machine-
+greppable ``#COMP:rank:iter:sec#`` / ``#DECOM:rank:iter:sec#`` / ``#IT:...#``
+markers (:301,336,397-398). This harness does the same on the TPU path:
+
+- **ici mode** (default): per-rank sub-VDIs are placed rank-sharded on the
+  device mesh and each iteration runs the one jitted SPMD step — width-axis
+  ``lax.all_to_all`` + fused sort-merge composite — exactly the production
+  pipeline's chain.
+- **compressed mode** (``--compressed``): the host hop — each rank's VDI is
+  split into per-destination column segments, compressed (zstd by default),
+  "exchanged", decompressed (timed as #DECOM) and composited (#COMP) — the
+  variable-length-collective wire format of io.vdi_io.pack_vdi_segments.
+
+Fixtures: ``--save-fixtures DIR`` writes per-rank sub-VDI .npz dumps from a
+procedural volume (the fake-sim fixture strategy, SURVEY.md §4.3);
+``--dir DIR`` replays existing dumps. Without either, fixtures are built
+in-memory.
+
+Runs on the virtual CPU mesh by default (set SITPU_BENCH_REAL=1 to use real
+devices when you have >= n of them). Prints markers + one JSON summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = "_SITPU_COMPBENCH_CHILD"
+
+
+def _reexec_virtual_mesh(n: int) -> None:
+    """Re-exec with an n-device virtual CPU platform (axon shim popped)."""
+    env = dict(os.environ)
+    env[_CHILD] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def build_fixtures(n: int, grid: int, width: int, height: int, k: int,
+                   max_steps: int):
+    """Per-rank sub-VDIs: each rank raycasts its z-slab of a procedural
+    volume, clipped half-open — the same decomposition the pipeline uses."""
+    import jax.numpy as jnp
+
+    from scenery_insitu_tpu.config import VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import procedural_volume
+    from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+
+    vol = procedural_volume(grid, kind="blobs", seed=11)
+    tf = for_dataset("procedural")
+    cam = Camera.create((0.2, 0.5, 2.9), fov_y_deg=45.0, near=0.3, far=10.0)
+    cfg = VDIConfig(max_supersegments=k, adaptive_iters=2)
+    d = grid
+    dz = float(vol.spacing[2])
+    vdis, metas = [], []
+    for r in range(n):
+        z0 = float(vol.origin[2]) + r * (d // n) * dz
+        z1 = float(vol.origin[2]) + (r + 1) * (d // n) * dz
+        cmin = jnp.asarray([vol.world_min[0], vol.world_min[1], z0])
+        cmax = jnp.asarray([vol.world_max[0], vol.world_max[1], z1])
+        vdi, meta = generate_vdi(vol, tf, cam, width, height, cfg,
+                                 max_steps=max_steps,
+                                 clip_min=cmin, clip_max=cmax)
+        vdis.append(vdi)
+        metas.append(meta)
+    return vdis, metas
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--grid", type=int, default=64)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--height", type=int, default=144)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--k-out", type=int, default=8)
+    ap.add_argument("--max-steps", type=int, default=96)
+    ap.add_argument("--compressed", action="store_true",
+                    help="host-hop per-segment compression variant")
+    ap.add_argument("--codec", default="zstd")
+    ap.add_argument("--dir", default=None,
+                    help="replay stored *_subvdi_*.npz fixtures from DIR")
+    ap.add_argument("--save-fixtures", default=None,
+                    help="write the generated fixtures to DIR and exit")
+    args = ap.parse_args()
+    n = args.ranks
+
+    if os.environ.get(_CHILD) != "1" and os.environ.get(
+            "SITPU_BENCH_REAL") != "1":
+        _reexec_virtual_mesh(n)
+
+    import jax
+
+    if os.environ.get(_CHILD) == "1":
+        # env vars alone do NOT stop the axon TPU shim from hanging backend
+        # lookup when the tunnel is down — drop its factory too
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge as _xb
+
+            _xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scenery_insitu_tpu.config import CompositeConfig
+    from scenery_insitu_tpu.core.vdi import VDI
+    from scenery_insitu_tpu.io.vdi_io import (dump_path, load_vdi,
+                                              pack_vdi_segments, save_vdi,
+                                              unpack_vdi_segments)
+    from scenery_insitu_tpu.runtime.timers import Timers
+
+    if args.dir:
+        paths = sorted(glob.glob(os.path.join(args.dir, "*_subvdi_*.npz")))
+        if len(paths) < n:
+            raise SystemExit(f"need {n} fixtures in {args.dir}, "
+                             f"found {len(paths)}")
+        vdis = [load_vdi(p)[0] for p in paths[:n]]
+        vdis = [VDI(jnp.asarray(v.color), jnp.asarray(v.depth))
+                for v in vdis]
+    else:
+        vdis, metas = build_fixtures(n, args.grid, args.width, args.height,
+                                     args.k, args.max_steps)
+        if args.save_fixtures:
+            for r, (v, m) in enumerate(zip(vdis, metas)):
+                p = dump_path(args.save_fixtures, "bench", r, "subvdi")
+                save_vdi(p, v, m, codec=args.codec)
+            print(f"wrote {n} fixtures to {args.save_fixtures}")
+            return
+
+    k, _, h, w = vdis[0].color.shape
+    comp_cfg = CompositeConfig(max_output_supersegments=args.k_out,
+                               adaptive_iters=2)
+    timers = Timers(window=args.iters, log=lambda s: None)
+
+    if not args.compressed:
+        # --------------------------- ICI path: the production SPMD chain
+        from scenery_insitu_tpu.ops.composite import composite_vdis
+        from scenery_insitu_tpu.parallel.mesh import make_mesh
+        from scenery_insitu_tpu.parallel.pipeline import _exchange_columns
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh(n)
+        axis = mesh.axis_names[0]
+
+        def step(color, depth):                 # [K,4,H,W] per rank
+            colors = _exchange_columns(color, n, axis)
+            depths = _exchange_columns(depth, n, axis)
+            out = composite_vdis(colors, depths, comp_cfg)
+            return out.color, out.depth
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(axis), P(axis)),
+            out_specs=(P(None, None, None, axis), P(None, None, None, axis)),
+            check_vma=False))
+
+        stack_c = jax.device_put(
+            jnp.concatenate([v.color for v in vdis]),
+            NamedSharding(mesh, P(axis)))
+        stack_d = jax.device_put(
+            jnp.concatenate([v.depth for v in vdis]),
+            NamedSharding(mesh, P(axis)))
+
+        oc, od = f(stack_c, stack_d)            # compile
+        jax.block_until_ready(oc)
+        total = 0.0
+        # chain an input perturbation so no layer can dedupe identical
+        # executions (see axon notes)
+        for it in range(args.iters):
+            t0 = time.perf_counter()
+            oc, od = f(stack_c, stack_d)
+            jax.block_until_ready(oc)
+            dt = time.perf_counter() - t0
+            total += dt
+            stack_c = stack_c.at[0, 0, 0, 0].add(float(oc[0, 0, 0, 0]) * 1e-6)
+            print(f"#COMP:all:{it}:{dt:.6f}#")
+            print(f"#IT:all:{it}:{dt:.6f}#")
+        summary = {
+            "metric": f"composite_ici_{n}ranks_k{k}_{w}x{h}",
+            "value": round(total / args.iters * 1000, 3),
+            "unit": "ms/iter",
+            "mode": "ici",
+            "backend": jax.default_backend(),
+        }
+    else:
+        # ------------------- compressed host hop (DCN / disk wire format)
+        from scenery_insitu_tpu.ops.composite import composite_vdis
+
+        total_comp = total_decom = 0.0
+        wire_bytes = 0
+        raw_bytes = n * (vdis[0].color.nbytes + vdis[0].depth.nbytes)
+        comp_jit = jax.jit(lambda c, d: composite_vdis(c, d, comp_cfg))
+        for it in range(args.iters):
+            # pack: each rank splits + compresses its VDI per destination
+            t0 = time.perf_counter()
+            packed = [pack_vdi_segments(v, n, codec=args.codec)
+                      for v in vdis]
+            t_pack = time.perf_counter() - t0
+            wire_bytes = sum(int(cl.sum() + dl.sum())
+                             for _, cl, dl in packed)
+
+            # "exchange": destination r receives segment r of every rank
+            t0 = time.perf_counter()
+            received = []
+            for r in range(n):
+                blobs = []
+                for src in range(n):
+                    sb, _, _ = packed[src]
+                    blobs.append(sb[r])             # color seg r
+                for src in range(n):
+                    sb, _, _ = packed[src]
+                    blobs.append(sb[n + r])         # depth seg r
+                received.append(unpack_vdi_segments(blobs, k, h, w // n * n,
+                                                    codec=args.codec))
+            t_decom = time.perf_counter() - t0
+            total_decom += t_pack + t_decom
+            print(f"#DECOM:all:{it}:{t_pack + t_decom:.6f}#")
+
+            # composite each destination's column block: received[r] holds
+            # n ranks' segments concatenated on W; restack to [n,K,.,H,W/n]
+            t0 = time.perf_counter()
+            outs = []
+            for r in range(n):
+                rc = np.asarray(received[r].color).reshape(k, 4, h, n, w // n)
+                rd = np.asarray(received[r].depth).reshape(k, 2, h, n, w // n)
+                cc = jnp.asarray(np.moveaxis(rc, 3, 0))
+                dd = jnp.asarray(np.moveaxis(rd, 3, 0))
+                outs.append(comp_jit(cc, dd))
+            jax.block_until_ready(outs[-1].color)
+            dt = time.perf_counter() - t0
+            total_comp += dt
+            print(f"#COMP:all:{it}:{dt:.6f}#")
+            print(f"#IT:all:{it}:{t_pack + t_decom + dt:.6f}#")
+        summary = {
+            "metric": f"composite_compressed_{n}ranks_k{k}_{w}x{h}",
+            "value": round((total_comp + total_decom) / args.iters * 1000, 3),
+            "unit": "ms/iter",
+            "mode": f"compressed/{args.codec}",
+            "compression_ratio": round(raw_bytes / max(wire_bytes, 1), 2),
+            "decompress_ms": round(total_decom / args.iters * 1000, 3),
+            "composite_ms": round(total_comp / args.iters * 1000, 3),
+            "backend": jax.default_backend(),
+        }
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

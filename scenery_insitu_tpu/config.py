@@ -92,13 +92,16 @@ class CompositeConfig:
 @dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh / parallelism settings (replaces rank/commSize fields the
-    reference received from C++: DistributedVolumes.kt:103-117)."""
+    reference received from C++: DistributedVolumes.kt:103-117).
+
+    Domain decomposition is 1-D over z (the pipeline's halo exchange and
+    ownership masks are built for z-slabs); unevenly-sized and multi-grid
+    per-rank layouts go through core.scene.MultiGridScene instead of a
+    decomposition knob here."""
 
     # Number of devices participating in sort-last compositing; 0 = all.
     num_devices: int = 0
     axis_name: str = "ranks"
-    # 3D domain-decomposition grid (dz, dy, dx); (0,0,0) = auto 1D over z.
-    decomposition: Tuple[int, int, int] = (0, 0, 0)
 
 
 @dataclass(frozen=True)
@@ -106,7 +109,8 @@ class SimConfig:
     """Built-in simulation settings (standalone mode; the reference could not
     run standalone — README.md:16 — this framework can)."""
 
-    kind: str = "gray_scott"        # gray_scott | vortex | lennard_jones | sho
+    # gray_scott | vortex | lennard_jones | sho | hybrid (vortex + tracers)
+    kind: str = "gray_scott"
     grid: Tuple[int, int, int] = (128, 128, 128)
     steps_per_frame: int = 10
     dt: float = 1.0
@@ -117,6 +121,9 @@ class SimConfig:
     gs_du: float = 0.16
     gs_dv: float = 0.08
     num_particles: int = 100_000
+    # Sphere radius for the particle/hybrid render paths: world units for
+    # lennard_jones/sho, voxel units for hybrid tracers.
+    particle_radius: float = 0.35
 
 
 @dataclass(frozen=True)
@@ -189,12 +196,32 @@ class FrameworkConfig:
             if not name.startswith(ENV_PREFIX):
                 continue
             parts = name[len(ENV_PREFIX):].lower().split("_", 1)
-            if len(parts) == 2 and hasattr(cfg, parts[0]):
-                try:
-                    cfg = _assign(cfg, parts, _parse_value(raw))
-                except (ValueError, AttributeError):
-                    pass
+            if len(parts) != 2 or not hasattr(cfg, parts[0]):
+                # not a config section: other SITPU_* tooling vars (e.g.
+                # SITPU_BENCH_*) share the prefix, so unknown sections
+                # cannot be errors — only unknown KEYS of real sections are
+                continue
+            if tuple(parts) in _REMOVED_KEYS:
+                import warnings
+                warnings.warn(f"config key {name} was removed "
+                              f"({_REMOVED_KEYS[tuple(parts)]}); ignored",
+                              stacklevel=2)
+                continue
+            try:
+                cfg = _assign(cfg, parts, _parse_value(raw))
+            except (ValueError, AttributeError) as e:
+                # a typo'd key/value must not silently do nothing (the
+                # reference's three config tiers failed silently too)
+                raise ValueError(
+                    f"bad config override {name}={raw!r}: {e}") from e
         return cfg.with_overrides(*overrides)
+
+
+# removed config keys -> deprecation note (accepted-and-warned, not fatal)
+_REMOVED_KEYS = {
+    ("mesh", "decomposition"): "decomposition is 1-D over z; multi-grid "
+                               "layouts go through core.scene.MultiGridScene",
+}
 
 
 def _parse_value(raw: str) -> Any:

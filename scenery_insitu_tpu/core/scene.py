@@ -1,0 +1,202 @@
+"""Multi-grid scene management (layer L2).
+
+The reference tracks, per compute partner, a *list* of grids with per-grid
+origins, grid extents and domain extents — OpenFPM's domain decomposition
+hands each rank an arbitrary set of boxes, not one even slab
+(``updateData(partnerNo, numGrids, grids, origins, gridDims, domainDims)``,
+reference DistributedVolumeRenderer.kt:57-64,116-160; per-grid Volume nodes
+at :341-386). ``MultiGridScene`` is that bookkeeping, TPU-first: each grid
+is a `Volume` (static shape ⇒ one jit specialization per grid-set
+signature), rendering treats the grids exactly like sort-last ranks — every
+grid raycasts/marches against the GLOBAL bounding box and the per-grid
+sub-VDIs merge through the ordinary composite kernel. Uneven and
+non-power-of-two decompositions need no special casing: disjoint interior
+AABBs are the only requirement, the same invariant the reference relies on.
+
+Ghost (halo) layers: simulation grids usually arrive with ghost cells on
+some faces (OpenFPM ships them; they make interpolation seam-exact). Pass
+``ghost_lo``/``ghost_hi`` voxel counts per axis; samples are clipped to the
+interior half-open box so every world position is owned by exactly one
+grid, and along the march axis ghost slices are dropped statically so no
+slab is double-counted.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from scenery_insitu_tpu.config import CompositeConfig, RenderConfig, VDIConfig
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.core.transfer import TransferFunction
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
+from scenery_insitu_tpu.core.volume import Volume
+
+
+class SceneGrid(NamedTuple):
+    volume: Volume                     # full data INCLUDING ghost layers
+    ghost_lo: Tuple[int, int, int]     # ghost voxels on the min faces (x,y,z)
+    ghost_hi: Tuple[int, int, int]     # ghost voxels on the max faces (x,y,z)
+
+    @property
+    def interior_min(self) -> jnp.ndarray:
+        g = jnp.asarray(self.ghost_lo, jnp.float32)
+        return self.volume.origin + g * self.volume.spacing
+
+    @property
+    def interior_max(self) -> jnp.ndarray:
+        g = jnp.asarray(self.ghost_hi, jnp.float32)
+        return self.volume.world_max - g * self.volume.spacing
+
+
+class MultiGridScene:
+    """Per-partner multi-grid bookkeeping + whole-scene rendering."""
+
+    def __init__(self):
+        self._grids: Dict[Tuple[int, int], SceneGrid] = {}
+
+    # ------------------------------------------------------------ operator
+    def update_data(self, partner: int, grids: Sequence[jnp.ndarray],
+                    origins: Sequence, spacing,
+                    ghost_lo: Optional[Sequence[Tuple[int, int, int]]] = None,
+                    ghost_hi: Optional[Sequence[Tuple[int, int, int]]] = None
+                    ) -> None:
+        """Replace partner's grid set (≅ updateData,
+        DistributedVolumeRenderer.kt:136-160). ``grids[i]`` is f32[D,H,W]
+        including ghosts; ``origins[i]`` is the world position of the full
+        grid's min corner (x, y, z)."""
+        for key in [k for k in self._grids if k[0] == partner]:
+            del self._grids[key]
+        for i, g in enumerate(grids):
+            self.set_grid(partner, i, g, origins[i], spacing,
+                          ghost_lo[i] if ghost_lo else (0, 0, 0),
+                          ghost_hi[i] if ghost_hi else (0, 0, 0))
+
+    def set_grid(self, partner: int, gid: int, data, origin, spacing,
+                 ghost_lo=(0, 0, 0), ghost_hi=(0, 0, 0)) -> None:
+        vol = Volume.create(data, origin, spacing)
+        self._grids[(partner, gid)] = SceneGrid(vol, tuple(ghost_lo),
+                                                tuple(ghost_hi))
+
+    def update_grid(self, partner: int, gid: int, data) -> None:
+        """New timestep for an existing grid (≅ updateVolume,
+        DistributedVolumes.kt:243-250)."""
+        g = self._grids[(partner, gid)]
+        self._grids[(partner, gid)] = g._replace(
+            volume=g.volume._replace(data=jnp.asarray(data, jnp.float32)))
+
+    @property
+    def grids(self) -> List[SceneGrid]:
+        return [self._grids[k] for k in sorted(self._grids)]
+
+    @property
+    def num_grids(self) -> int:
+        return len(self._grids)
+
+    def global_bounds(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Union AABB of the grid interiors (the scene's world box)."""
+        gs = self.grids
+        lo = gs[0].interior_min
+        hi = gs[0].interior_max
+        for g in gs[1:]:
+            lo = jnp.minimum(lo, g.interior_min)
+            hi = jnp.maximum(hi, g.interior_max)
+        return lo, hi
+
+    # ----------------------------------------------------------- rendering
+    def generate_vdi(self, tf: TransferFunction, cam: Camera,
+                     width: int, height: int,
+                     cfg: Optional[VDIConfig] = None,
+                     comp_cfg: Optional[CompositeConfig] = None,
+                     max_steps: int = 256) -> Tuple[VDI, VDIMetadata]:
+        """Whole-scene VDI on the gather path: each grid raycasts clipped to
+        its interior box, the sub-VDIs sort-last composite (grids play the
+        role of ranks)."""
+        from scenery_insitu_tpu.ops.composite import composite_vdis
+        from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+
+        vdis = []
+        meta = None
+        for g in self.grids:
+            vdi, meta = generate_vdi(g.volume, tf, cam, width, height, cfg,
+                                     max_steps=max_steps,
+                                     clip_min=g.interior_min,
+                                     clip_max=g.interior_max)
+            vdis.append(vdi)
+        lo, hi = self.global_bounds()
+        dims = (hi - lo) / self.grids[0].volume.spacing
+        meta = meta._replace(volume_dims=dims)
+        out = composite_vdis(jnp.stack([v.color for v in vdis]),
+                             jnp.stack([v.depth for v in vdis]), comp_cfg)
+        return out, meta
+
+    def generate_vdi_mxu(self, tf: TransferFunction, cam: Camera, spec,
+                         cfg: Optional[VDIConfig] = None,
+                         comp_cfg: Optional[CompositeConfig] = None
+                         ) -> Tuple[VDI, VDIMetadata]:
+        """Whole-scene VDI on the MXU slice march. Every grid marches
+        against the global box (shared slice ladder + intermediate grid);
+        ghost slices along the march axis are dropped statically so no slab
+        is double-counted, in-plane ghosts stay for seam-exact bilinear
+        with half-open ownership bounds (the same scheme as the distributed
+        pipeline's `_mxu_rank_generate`)."""
+        from scenery_insitu_tpu.ops import slicer
+        from scenery_insitu_tpu.ops.composite import composite_vdis
+
+        lo, hi = self.global_bounds()
+        a, ua, va = spec.axis, spec.u_axis, spec.v_axis
+        data_dim = {0: 2, 1: 1, 2: 0}   # xyz axis -> data dim of [z, y, x]
+
+        vdis = []
+        meta = None
+        for g in self.grids:
+            # drop ghost slices along the march axis (static slicing)
+            dd = data_dim[a]
+            n_a = g.volume.data.shape[dd]
+            sl = [slice(None)] * 3
+            sl[dd] = slice(g.ghost_lo[a], n_a - g.ghost_hi[a] or None)
+            data = g.volume.data[tuple(sl)]
+            origin = g.volume.origin
+            origin = origin.at[a].add(g.ghost_lo[a] * g.volume.spacing[a])
+            vol = Volume(data, origin, g.volume.spacing)
+
+            # half-open ownership on the in-plane axes; at the global max
+            # face re-admit pos == hi (capped by the volume-extent mask)
+            def bounds(ax):
+                blo = g.interior_min[ax]
+                bhi = g.interior_max[ax]
+                slack = jnp.where(bhi >= hi[ax] - 1e-6,
+                                  g.volume.spacing[ax], 0.0)
+                return (blo, bhi + slack)
+
+            vdi, meta, _ = slicer.generate_vdi_mxu(
+                vol, tf, cam, spec, cfg, box_min=lo, box_max=hi,
+                u_bounds=bounds(ua), v_bounds=bounds(va))
+            vdis.append(vdi)
+        dims = (hi - lo) / self.grids[0].volume.spacing
+        meta = meta._replace(volume_dims=dims)
+        out = composite_vdis(jnp.stack([v.color for v in vdis]),
+                             jnp.stack([v.depth for v in vdis]), comp_cfg)
+        return out, meta
+
+    def render(self, tf: TransferFunction, cam: Camera,
+               width: int, height: int,
+               cfg: Optional[RenderConfig] = None) -> jnp.ndarray:
+        """Whole-scene plain image: per-grid raycast + sort-last plain
+        composite (≅ the reference's per-grid Volume nodes all rendered
+        into one view)."""
+        import dataclasses
+
+        from scenery_insitu_tpu.ops.composite import composite_plain
+        from scenery_insitu_tpu.ops.raycast import raycast
+
+        cfg = cfg or RenderConfig(width=width, height=height)
+        rank_cfg = dataclasses.replace(cfg, background=(0.0,) * 4)
+        outs = [raycast(g.volume, tf, cam, width, height, rank_cfg,
+                        clip_min=g.interior_min, clip_max=g.interior_max)
+                for g in self.grids]
+        return composite_plain(jnp.stack([o.image for o in outs]),
+                               jnp.stack([o.depth for o in outs]),
+                               cfg.background)

@@ -204,6 +204,11 @@ DATASET_TRANSFER_FUNCTIONS = {
     "procedural": lambda: TransferFunction.ramp(0.05, 0.8, 0.5, "hot"),
     "gray_scott": lambda: TransferFunction.points(
         [(0.0, 0.0), (0.12, 0.0), (0.3, 0.12), (0.65, 0.3), (1.0, 0.5)], "viridis"),
+    # vorticity-magnitude fields (vortex sim + the hybrid tracer mode render
+    # the same field, so the session and the single-chip Config 5 pipeline
+    # must agree on one TF)
+    "vortex": lambda: TransferFunction.ramp(0.0, 1.0, 0.4, "jet"),
+    "hybrid": lambda: TransferFunction.ramp(0.0, 1.0, 0.4, "jet"),
 }
 
 

@@ -78,6 +78,64 @@ def grayscott_vdi_frame_step(width: int, height: int,
     return frame_step
 
 
+def hybrid_vortex_frame_step(width: int, height: int,
+                             grid_shape, axis_sign,
+                             sim_steps: int = 3,
+                             vdi_cfg: Optional[VDIConfig] = None,
+                             tf: Optional[TransferFunction] = None,
+                             radius: float = 0.02, stamp: int = 5,
+                             colormap: str = "jet",
+                             fov_y_deg: float = 50.0,
+                             slicer_cfg=None,
+                             background=(0.0, 0.0, 0.0, 0.0)):
+    """Single-chip hybrid frame step (BASELINE.md Config 5): vortex-ring
+    flow advanced in-situ, vorticity volume rendered as a VDI on the MXU
+    slice march, passive tracers advected through the same flow and
+    splatted as opaque spheres onto the SAME virtual-camera rays, then
+    depth-correct merged (ops/hybrid.py) and warped to the display camera.
+
+    Returns ``fn(u_flow, tracer_pos, eye) -> (image [4,H,W], u', pos')``
+    (jittable). ``tracer_pos`` is in voxel coordinates (see
+    vortex.seed_tracers).
+    """
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.ops.hybrid import composite_vdi_with_particles
+    from scenery_insitu_tpu.sim import vortex
+
+    tf = tf or for_dataset("hybrid")
+    vdi_cfg = vdi_cfg or VDIConfig(max_supersegments=8, adaptive_iters=2)
+    params = vortex.VortexParams.create()
+    spec = slicer.make_spec(
+        Camera.create((0.0, 0.6, 3.0), fov_y_deg=fov_y_deg),
+        tuple(grid_shape), slicer_cfg, axis_sign=axis_sign)
+
+    def frame_step(u_flow, tracer_pos, eye):
+        flow = vortex.VortexFlow(u_flow, params)
+
+        def advance(_, carry):
+            fl, pos = carry
+            pos = vortex.advect_tracers(fl.u, pos, params.dt)
+            return vortex.step(fl), pos
+
+        flow, tracer_pos2 = jax.lax.fori_loop(0, sim_steps, advance,
+                                              (flow, tracer_pos))
+        vol = Volume.centered(flow.field, extent=2.0)
+        cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
+        vdi, _, axcam = slicer.generate_vdi_mxu(vol, tf, cam, spec, vdi_cfg)
+
+        vel = vortex.tracer_velocities(flow.u, tracer_pos2)
+        rgba = speed_colors(vel, colormap)
+        world = vortex.tracers_to_world(tracer_pos2, vol.origin, vol.spacing)
+        sp = splat_particles(world, rgba, radius, None, spec.ni, spec.nj,
+                             stamp, view=axcam.view, proj=axcam.proj)
+        inter = composite_vdi_with_particles(vdi, sp)
+        img = slicer.warp_to_camera(inter, axcam, spec, cam, width, height,
+                                    background)
+        return img, flow.u, tracer_pos2
+
+    return frame_step
+
+
 def lj_particle_frame_step(width: int, height: int,
                            params: pt.LJParams, spec: pt.CellSpec,
                            sim_steps: int = 5, radius: float = 0.35,

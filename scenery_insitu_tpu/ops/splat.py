@@ -13,9 +13,13 @@ Lambert), so a particle occludes correctly against other particles both
 within a rank and across ranks (sort-first depth-min composite,
 ops.composite.composite_depth_min ≅ Head.kt:98-134).
 
-Depths are the eye-space view depth (distance along the camera forward
-axis), matching the plain-image raycaster's depth output so particle and
-volume images can be composited against each other.
+Depths are the world-space ray parameter t — the Euclidean distance from
+the eye, the ONE depth convention of the whole framework (core/vdi.py
+docstring; the raycasters and VDIs use the same), so particle fragments
+depth-compare and hybrid-composite exactly against volume renders and VDI
+supersegments everywhere in the frame, not just at the image center. (The
+reference mixed conventions and needed a converter pass; see SURVEY.md §7
+"hard parts".)
 """
 
 from __future__ import annotations
@@ -31,7 +35,7 @@ from scenery_insitu_tpu.core.transfer import colormap_lut
 
 class SplatOutput(NamedTuple):
     image: jnp.ndarray   # f32[4, H, W] premultiplied RGBA
-    depth: jnp.ndarray   # f32[H, W] view depth; +inf where empty
+    depth: jnp.ndarray   # f32[H, W] ray-parameter depth; +inf where empty
 
 
 def speed_colors(vel: jnp.ndarray, colormap: str = "jet",
@@ -59,9 +63,12 @@ def speed_colors(vel: jnp.ndarray, colormap: str = "jet",
 
 
 def splat_particles(pos: jnp.ndarray, rgba: jnp.ndarray, radius,
-                    cam: Camera, width: int, height: int,
+                    cam: Optional[Camera], width: int, height: int,
                     stamp: int = 9, ambient: float = 0.25,
-                    radii: Optional[jnp.ndarray] = None) -> SplatOutput:
+                    radii: Optional[jnp.ndarray] = None,
+                    view: Optional[jnp.ndarray] = None,
+                    proj: Optional[jnp.ndarray] = None,
+                    near: float = 1e-3, far: float = jnp.inf) -> SplatOutput:
     """Render particles as lit opaque spheres.
 
     pos f32[N, 3] world positions; rgba f32[N, 4] straight colors;
@@ -69,15 +76,25 @@ def splat_particles(pos: jnp.ndarray, rgba: jnp.ndarray, radius,
     ``radii`` f32[N]); ``stamp`` static odd stamp side in pixels — the
     on-screen radius is clamped to ``stamp // 2`` px, so pick stamp to fit
     the nearest particles.
+
+    Pass explicit ``view``/``proj`` 4×4 matrices (with ``cam=None``) to
+    splat onto an arbitrary frustum — e.g. the slice-march engine's virtual
+    axis camera, which is how the hybrid pipeline shares rays between
+    particles and the volume VDI (ops/hybrid.py).
     """
     n = pos.shape[0]
-    view = view_matrix(cam)
-    proj = projection_matrix(cam, width, height)
+    if view is None:
+        view = view_matrix(cam)
+    if proj is None:
+        proj = projection_matrix(cam, width, height)
+    if cam is not None:
+        near, far = cam.near, cam.far
     r_world = jnp.broadcast_to(jnp.asarray(radius, jnp.float32), (n,)) \
         if radii is None else radii
 
     p_eye = pos @ view[:3, :3].T + view[:3, 3]             # [N, 3]
     z = -p_eye[:, 2]                                        # view depth, >0 in front
+    t_ray = jnp.linalg.norm(p_eye, axis=-1)                 # ray parameter
     clip = p_eye @ proj[:3, :3].T + proj[:3, 3]
     w_clip = -p_eye[:, 2]                                   # proj[3] = (0,0,-1,0)
     ndc = clip[:, :2] / jnp.where(w_clip == 0.0, 1e-12, w_clip)[:, None]
@@ -85,7 +102,7 @@ def splat_particles(pos: jnp.ndarray, rgba: jnp.ndarray, radius,
     py = (1.0 - ndc[:, 1]) * 0.5 * height - 0.5
     r_px = r_world * proj[1, 1] * (height * 0.5) / jnp.maximum(z, 1e-6)
     r_px = jnp.minimum(r_px, stamp // 2)
-    visible = (z > cam.near) & (z < cam.far) & (r_px > 0.05)
+    visible = (z > near) & (z < far) & (r_px > 0.05)
 
     # S×S stamp around each particle's center pixel
     half = stamp // 2
@@ -103,7 +120,7 @@ def splat_particles(pos: jnp.ndarray, rgba: jnp.ndarray, radius,
     # impostor depth offset + normal: the pixel samples the sphere surface
     frac2 = jnp.clip(d2 / jnp.maximum(r_px[:, None] ** 2, 1e-12), 0.0, 1.0)
     nz = jnp.sqrt(1.0 - frac2)                              # [N, S²]
-    depth = z[:, None] - nz * r_world[:, None]
+    depth = t_ray[:, None] - nz * r_world[:, None]          # ray-parameter t
     shade = ambient + (1.0 - ambient) * nz
     a = rgba[:, 3:4]
     prgb = rgba[:, :3][:, None, :] * (shade * a)[:, :, None]  # [N, S², 3]

@@ -28,6 +28,32 @@ from scenery_insitu_tpu.ops.splat import (SplatOutput, speed_colors,
 shard_map = jax.shard_map
 
 
+def sort_first_splat(pos, vel, axis: str, width: int, height: int,
+                     radius, stamp: int = 9, colormap: str = "jet",
+                     cam: Optional[Camera] = None, view=None, proj=None
+                     ) -> SplatOutput:
+    """The per-rank body of sort-first particle rendering (call inside
+    shard_map): speed-color with globally psum-reduced statistics (the
+    reference computes these over the full population too,
+    InVisRenderer.kt:166-175), splat this rank's spheres, all_gather the
+    small image+depth pair, per-pixel depth-min. Returns a replicated
+    SplatOutput. Shared by the particle and hybrid pipelines."""
+    speed = jnp.linalg.norm(vel, axis=-1)
+    cnt = jax.lax.psum(jnp.float32(speed.shape[0]), axis)
+    s1 = jax.lax.psum(jnp.sum(speed), axis)
+    s2 = jax.lax.psum(jnp.sum(speed * speed), axis)
+    mean = s1 / cnt
+    std = jnp.sqrt(jnp.maximum(s2 / cnt - mean * mean, 0.0))
+
+    rgba = speed_colors(vel, colormap, mean=mean, std=std)
+    out = splat_particles(pos, rgba, radius, cam, width, height, stamp,
+                          view=view, proj=proj)
+    imgs = jax.lax.all_gather(out.image, axis)              # [n, 4, H, W]
+    deps = jax.lax.all_gather(out.depth, axis)              # [n, H, W]
+    img, dep = composite_depth_min(imgs, deps)
+    return SplatOutput(img, dep)
+
+
 def distributed_particle_step(mesh: Mesh, width: int, height: int,
                               radius: float = 0.01, stamp: int = 9,
                               colormap: str = "jet",
@@ -41,21 +67,8 @@ def distributed_particle_step(mesh: Mesh, width: int, height: int,
     axis = axis_name or mesh.axis_names[0]
 
     def step(pos, vel, cam: Camera) -> SplatOutput:
-        # global speed statistics (the reference computes these over the
-        # full population too, InVisRenderer.kt:166-175)
-        speed = jnp.linalg.norm(vel, axis=-1)
-        cnt = jax.lax.psum(jnp.float32(speed.shape[0]), axis)
-        s1 = jax.lax.psum(jnp.sum(speed), axis)
-        s2 = jax.lax.psum(jnp.sum(speed * speed), axis)
-        mean = s1 / cnt
-        std = jnp.sqrt(jnp.maximum(s2 / cnt - mean * mean, 0.0))
-
-        rgba = speed_colors(vel, colormap, mean=mean, std=std)
-        out = splat_particles(pos, rgba, radius, cam, width, height, stamp)
-        imgs = jax.lax.all_gather(out.image, axis)          # [n, 4, H, W]
-        deps = jax.lax.all_gather(out.depth, axis)          # [n, H, W]
-        img, dep = composite_depth_min(imgs, deps)
-        return SplatOutput(img, dep)
+        return sort_first_splat(pos, vel, axis, width, height, radius,
+                                stamp, colormap, cam=cam)
 
     spec_part = P(axis, None)
     f = shard_map(step, mesh=mesh, in_specs=(spec_part, spec_part, P()),

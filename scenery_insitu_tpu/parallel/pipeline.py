@@ -111,6 +111,46 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
     return jax.jit(f)
 
 
+def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
+                       tf, vdi_cfg, axis, n):
+    """Per-rank slice-march VDI generation on a z-slab (shared by the
+    distributed VDI and hybrid steps). Returns (vdi, meta, axcam)."""
+    r = jax.lax.axis_index(axis)
+    dn = local_data.shape[0]
+    h, w = local_data.shape[1], local_data.shape[2]
+    dz = spacing[2]
+    gmax = origin + jnp.array([w, h, dn * n], jnp.float32) * spacing
+
+    if spec.axis == 2:
+        # march along the domain axis: each rank marches only its own
+        # slab slices — no halo, no ownership masks needed
+        local_origin = origin.at[2].add(r * dn * dz)
+        vol = Volume(local_data, local_origin, spacing)
+        v_bounds = None
+    else:
+        # march along x/y: the in-plane v axis is the sharded z axis —
+        # halo rows for seam-exact bilinear, half-open ownership so
+        # every sample belongs to exactly one rank
+        halo = halo_exchange_z(local_data, axis)           # [Dn+2, H, W]
+        local_origin = origin.at[2].add((r * dn - 1) * dz)
+        vol = Volume(halo, local_origin, spacing)
+        z_lo = origin[2] + r * dn * dz
+        z_hi = origin[2] + (r + 1) * dn * dz
+        # edge ranks keep the exact global extent as their bound (the
+        # clamped halo row must never render the band beyond it, which
+        # single-device treats as outside the volume); the +dz slack on
+        # the last rank only re-admits pos == global max, which the
+        # volume-extent mask in _interp_matrix still caps
+        v_bounds = (z_lo, jnp.where(r == n - 1, z_hi + dz, z_hi))
+
+    vdi, meta, axcam = slicer.generate_vdi_mxu(
+        vol, tf, cam, spec, vdi_cfg,
+        box_min=origin, box_max=gmax, v_bounds=v_bounds)
+    # metadata must describe the GLOBAL volume, not this rank's slab
+    meta = meta._replace(volume_dims=jnp.array([w, h, dn * n], jnp.float32))
+    return vdi, meta, axcam
+
+
 def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
                              spec, vdi_cfg: Optional[VDIConfig] = None,
                              comp_cfg: Optional[CompositeConfig] = None,
@@ -140,40 +180,8 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
                          f"mesh size {n}")
 
     def step(local_data, origin, spacing, cam: Camera):
-        r = jax.lax.axis_index(axis)
-        dn = local_data.shape[0]
-        h, w = local_data.shape[1], local_data.shape[2]
-        dz = spacing[2]
-        gmax = origin + jnp.array([w, h, dn * n], jnp.float32) * spacing
-
-        if spec.axis == 2:
-            # march along the domain axis: each rank marches only its own
-            # slab slices — no halo, no ownership masks needed
-            local_origin = origin.at[2].add(r * dn * dz)
-            vol = Volume(local_data, local_origin, spacing)
-            v_bounds = None
-        else:
-            # march along x/y: the in-plane v axis is the sharded z axis —
-            # halo rows for seam-exact bilinear, half-open ownership so
-            # every sample belongs to exactly one rank
-            halo = halo_exchange_z(local_data, axis)       # [Dn+2, H, W]
-            local_origin = origin.at[2].add((r * dn - 1) * dz)
-            vol = Volume(halo, local_origin, spacing)
-            z_lo = origin[2] + r * dn * dz
-            z_hi = origin[2] + (r + 1) * dn * dz
-            # edge ranks keep the exact global extent as their bound (the
-            # clamped halo row must never render the band beyond it, which
-            # single-device treats as outside the volume); the +dz slack on
-            # the last rank only re-admits pos == global max, which the
-            # volume-extent mask in _interp_matrix still caps
-            v_bounds = (z_lo, jnp.where(r == n - 1, z_hi + dz, z_hi))
-
-        vdi, meta, _ = slicer.generate_vdi_mxu(
-            vol, tf, cam, spec, vdi_cfg,
-            box_min=origin, box_max=gmax, v_bounds=v_bounds)
-        # metadata must describe the GLOBAL volume, not this rank's slab
-        meta = meta._replace(
-            volume_dims=jnp.array([w, h, dn * n], jnp.float32))
+        vdi, meta, _ = _mxu_rank_generate(local_data, origin, spacing, cam,
+                                          slicer, spec, tf, vdi_cfg, axis, n)
         colors = _exchange_columns(vdi.color, n, axis)     # [n,K,4,Nj,Ni/n]
         depths = _exchange_columns(vdi.depth, n, axis)
         return composite_vdis(colors, depths, comp_cfg), meta
@@ -185,6 +193,69 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
     f = shard_map(step, mesh=mesh,
                   in_specs=(spec_vol, P(), P(), P()),
                   out_specs=(out_vdi, out_meta), check_vma=False)
+    return jax.jit(f)
+
+
+def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
+                                spec, vdi_cfg: Optional[VDIConfig] = None,
+                                comp_cfg: Optional[CompositeConfig] = None,
+                                radius: float = 0.02, stamp: int = 5,
+                                colormap: str = "jet",
+                                axis_name: Optional[str] = None):
+    """Distributed hybrid volume+particle frame (BASELINE.md Config 5):
+    z-sharded volume through the sort-last MXU VDI chain, N-sharded
+    tracers through the sort-first splat chain (per-rank z-buffer,
+    all_gather, depth-min — ≅ InVisRenderer + Head running concurrently
+    with DistributedVolumes), then the particle layer is depth-inserted
+    into each rank's composited VDI columns (ops/hybrid.py). One jitted
+    SPMD program.
+
+    Returns ``f(vol_data f32[D,H,W] (z-sharded), origin, spacing,
+    tracer_world f32[N,3] (N-sharded), tracer_vel f32[N,3] (same), cam)
+    -> (image f32[4, Nj, Ni] W-sharded on the virtual grid, meta)``.
+    Warp to the display camera with ops.slicer.warp_to_camera.
+    """
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.ops.hybrid import composite_vdi_with_particles
+    from scenery_insitu_tpu.ops.splat import SplatOutput
+    from scenery_insitu_tpu.parallel.particles import sort_first_splat
+
+    vdi_cfg = vdi_cfg or VDIConfig()
+    comp_cfg = comp_cfg or CompositeConfig()
+    axis = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    if spec.ni % n:
+        raise ValueError(f"intermediate width {spec.ni} not divisible by "
+                         f"mesh size {n}")
+
+    def step(local_data, origin, spacing, tr_pos, tr_vel, cam: Camera):
+        vdi, meta, axcam = _mxu_rank_generate(local_data, origin, spacing,
+                                              cam, slicer, spec, tf,
+                                              vdi_cfg, axis, n)
+        colors = _exchange_columns(vdi.color, n, axis)
+        depths = _exchange_columns(vdi.depth, n, axis)
+        comp = composite_vdis(colors, depths, comp_cfg)    # [Ko,·,Nj,Ni/n]
+
+        # sort-first particle pass on the virtual camera's rays
+        sp = sort_first_splat(tr_pos, tr_vel, axis, spec.ni, spec.nj,
+                              radius, stamp, colormap,
+                              view=axcam.view, proj=axcam.proj)
+
+        # my column block of the (replicated) particle layer
+        r = jax.lax.axis_index(axis)
+        wb = spec.ni // n
+        img_b = jax.lax.dynamic_slice_in_dim(sp.image, r * wb, wb, axis=2)
+        dep_b = jax.lax.dynamic_slice_in_dim(sp.depth, r * wb, wb, axis=1)
+        hyb = composite_vdi_with_particles(comp, SplatOutput(img_b, dep_b))
+        return hyb, meta
+
+    from scenery_insitu_tpu.core.vdi import VDIMetadata
+    out_meta = VDIMetadata(*(P() for _ in VDIMetadata._fields))
+    f = shard_map(step, mesh=mesh,
+                  in_specs=(P(axis, None, None), P(), P(),
+                            P(axis, None), P(axis, None), P()),
+                  out_specs=(P(None, None, axis), out_meta),
+                  check_vma=False)
     return jax.jit(f)
 
 

@@ -43,8 +43,7 @@ Sink = Callable[[int, dict], None]
 
 class VolumeSimAdapter:
     """Uniform facade over the built-in volume sims (kind -> state/advance/
-    field). Particle sims go through models.pipelines.lj_particle_frame_step
-    instead."""
+    field)."""
 
     def __init__(self, cfg: FrameworkConfig, seed: int = 0):
         kind = cfg.sim.kind
@@ -67,6 +66,84 @@ class VolumeSimAdapter:
         return self.state.field
 
 
+class ParticleSimAdapter:
+    """Session facade over the built-in particle sims (lennard_jones | sho;
+    ≅ the reference's MD-driven InVisRenderer path and the SHO workload of
+    its shm producer, shm_mpiproducer.cpp:85-122)."""
+
+    def __init__(self, cfg: FrameworkConfig, seed: int = 0):
+        from functools import partial
+
+        from scenery_insitu_tpu.sim import particles as pt
+
+        kind = cfg.sim.kind
+        self.kind = kind
+        n = cfg.sim.num_particles
+        if kind == "lennard_jones":
+            self.state, params, spec = pt.lj_init(n, seed=seed)
+            self._advance = partial(pt.lj_multi_step, params=params,
+                                    spec=spec)
+        elif kind == "sho":
+            self.state, params = pt.sho_init(n, seed=seed)
+
+            @partial(jax.jit, static_argnames="n")
+            def sho_multi(s, n):
+                return jax.lax.fori_loop(
+                    0, n, lambda _, st: pt.sho_step(st, params), s)
+
+            self._advance = sho_multi
+        else:
+            raise ValueError(f"unknown particle sim kind {kind!r}")
+
+    def advance(self, n: int) -> None:
+        self.state = self._advance(self.state, n=n)
+
+    @property
+    def pos(self) -> jnp.ndarray:
+        return self.state.pos
+
+    @property
+    def vel(self) -> jnp.ndarray:
+        return self.state.vel
+
+
+class HybridSimAdapter:
+    """Vortex flow + passive tracers for the hybrid session mode
+    (BASELINE.md Config 5)."""
+
+    def __init__(self, cfg: FrameworkConfig, seed: int = 0):
+        grid = tuple(cfg.sim.grid)
+        self.kind = "hybrid"
+        self.flow = vx.VortexFlow.init_ring(
+            grid, vx.VortexParams.create(dt=cfg.sim.dt))
+        self.tracers = vx.seed_tracers(grid, cfg.sim.num_particles,
+                                       seed=seed)
+
+        @jax.jit
+        def _adv(u, pos, n):
+            params = self.flow.params
+
+            def body(_, carry):
+                fl, p = carry
+                p = vx.advect_tracers(fl.u, p, params.dt)
+                return vx.step(fl), p
+
+            fl, p = jax.lax.fori_loop(0, n, body,
+                                      (vx.VortexFlow(u, params), pos))
+            return fl.u, p
+
+        self._adv = _adv
+
+    def advance(self, n: int) -> None:
+        u, self.tracers = self._adv(self.flow.u, self.tracers,
+                                    jnp.int32(n))
+        self.flow = self.flow._replace(u=u)
+
+    @property
+    def field(self) -> jnp.ndarray:
+        return self.flow.field
+
+
 class InSituSession:
     def __init__(self, cfg: Optional[FrameworkConfig] = None,
                  mesh=None, camera: Optional[Camera] = None,
@@ -78,7 +155,14 @@ class InSituSession:
         self.mesh = mesh if mesh is not None else make_mesh(
             self.cfg.mesh.num_devices, self.cfg.mesh.axis_name)
         self.timers = Timers(window=self.cfg.runtime.stats_window, log=self.log)
-        self.sim = sim or VolumeSimAdapter(self.cfg)
+        if sim is not None:
+            self.sim = sim
+        elif self.cfg.sim.kind in ("lennard_jones", "sho"):
+            self.sim = ParticleSimAdapter(self.cfg)
+        elif self.cfg.sim.kind == "hybrid":
+            self.sim = HybridSimAdapter(self.cfg)
+        else:
+            self.sim = VolumeSimAdapter(self.cfg)
         self.tf = tf or for_dataset(
             self.cfg.sim.kind if self.cfg.runtime.dataset == "procedural"
             else self.cfg.runtime.dataset)
@@ -97,25 +181,48 @@ class InSituSession:
         # engine selection: the MXU slice march is implemented for the VDI
         # pipeline; plain-image mode always uses the gather path
         self.engine = _slicer.resolve_engine(self.cfg.slicer.engine)
-        if self.cfg.runtime.generate_vdis and self.engine == "mxu":
+        self._mxu_steps = {}   # (axis, sign) -> jitted distributed step
+        self.mode = "vdi"
+        if isinstance(self.sim, ParticleSimAdapter):
+            # sort-first sphere rendering (≅ InVisRenderer + Head)
+            from scenery_insitu_tpu.parallel.particles import (
+                distributed_particle_step)
+            self.mode = "particles"
+            self._step = distributed_particle_step(
+                self.mesh, r.width, r.height,
+                radius=self.cfg.sim.particle_radius)
+        elif isinstance(self.sim, HybridSimAdapter):
+            # hybrid is implemented on the slice-march engine only (the
+            # particle layer shares the virtual camera's rays); the engine
+            # knob is overridden so telemetry reports what actually runs
+            self.mode = "hybrid"
+            self.engine = "mxu"
             self._step = None
-            self._mxu_steps = {}   # (axis, sign) -> jitted distributed step
+        elif self.cfg.runtime.generate_vdis and self.engine == "mxu":
+            self._step = None
         elif self.cfg.runtime.generate_vdis:
             self._step = distributed_vdi_step(
                 self.mesh, self.tf, r.width, r.height,
                 self.cfg.vdi, self.cfg.composite, max_steps=r.max_steps)
         else:
             self.engine = "gather"
+            self.mode = "plain"
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r)
 
         # world placement: sim grid centered, largest side = 2 world units
-        d, h, w = (tuple(self.cfg.sim.grid) if sim is None
-                   else np.asarray(self.sim.field.shape))
-        vox = 2.0 / max(d, h, w)
-        self._origin = jnp.asarray([-w * vox / 2, -h * vox / 2, -d * vox / 2],
-                                   jnp.float32)
-        self._spacing = jnp.full((3,), vox, jnp.float32)
+        if self.mode == "particles":
+            # particle box [0, box) is rendered centered by the step itself
+            d = h = w = 1
+            self._origin = jnp.zeros((3,), jnp.float32)
+            self._spacing = jnp.ones((3,), jnp.float32)
+        else:
+            d, h, w = (tuple(self.cfg.sim.grid) if sim is None
+                       else np.asarray(self.sim.field.shape))
+            vox = 2.0 / max(d, h, w)
+            self._origin = jnp.asarray(
+                [-w * vox / 2, -h * vox / 2, -d * vox / 2], jnp.float32)
+            self._spacing = jnp.full((3,), vox, jnp.float32)
 
     # ------------------------------------------------------------- frames
 
@@ -132,15 +239,27 @@ class InSituSession:
         with self.timers.phase("sim"):
             self.sim.advance(self.cfg.sim.steps_per_frame)
         with self.timers.phase("dispatch"):
-            field = shard_volume(self.sim.field, self.mesh)
-            if self._step is not None:
-                out = self._step(field, self._origin, self._spacing,
+            if self.mode == "particles":
+                from scenery_insitu_tpu.parallel.particles import (
+                    shard_particles)
+                centered = self.sim.pos - self.sim.state.box / 2.0
+                out = self._step(shard_particles(centered, self.mesh),
+                                 shard_particles(self.sim.vel, self.mesh),
                                  self.camera)
                 meta = self.frame_metadata(self.frame_index)
-            else:
-                out, meta = self._mxu_step()(field, self._origin,
-                                             self._spacing, self.camera)
+            elif self.mode == "hybrid":
+                out, meta = self._hybrid_dispatch()
                 meta = meta._replace(index=jnp.int32(self.frame_index))
+            else:
+                field = shard_volume(self.sim.field, self.mesh)
+                if self._step is not None:
+                    out = self._step(field, self._origin, self._spacing,
+                                     self.camera)
+                    meta = self.frame_metadata(self.frame_index)
+                else:
+                    out, meta = self._mxu_step()(field, self._origin,
+                                                 self._spacing, self.camera)
+                    meta = meta._replace(index=jnp.int32(self.frame_index))
         # metadata snapshot BEFORE the camera advances (fetch is pipelined
         # one frame behind, so it must not see the next frame's pose)
         self._pending_meta[self.frame_index] = meta
@@ -165,10 +284,14 @@ class InSituSession:
         return payload
 
     def _fetch(self, index: int, out) -> dict:
+        from scenery_insitu_tpu.ops.splat import SplatOutput
         with self.timers.phase("fetch"):
             if isinstance(out, VDI):
                 payload = {"vdi_color": np.asarray(out.color),
                            "vdi_depth": np.asarray(out.depth)}
+            elif isinstance(out, SplatOutput):
+                payload = {"image": np.asarray(out.image),
+                           "depth": np.asarray(out.depth)}
             else:
                 payload = {"image": np.asarray(out)}
             payload["frame"] = index
@@ -178,6 +301,48 @@ class InSituSession:
             for s in self.sinks:
                 s(index, payload)
         return payload
+
+    def _hybrid_dispatch(self):
+        """Dispatch one distributed hybrid frame: volume VDI + tracers,
+        merged on the virtual grid, warped to the display camera."""
+        from scenery_insitu_tpu.core.volume import Volume
+        from scenery_insitu_tpu.parallel.particles import shard_particles
+        from scenery_insitu_tpu.parallel.pipeline import (
+            distributed_hybrid_step_mxu)
+        from scenery_insitu_tpu.sim import vortex as _vx
+
+        regime = self._slicer.choose_axis(self.camera)
+        entry = self._mxu_steps.get(("hybrid",) + regime)
+        if entry is None:
+            n = self.mesh.shape[self.cfg.mesh.axis_name]
+            spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
+                                          self.cfg.slicer, axis_sign=regime,
+                                          multiple_of=n)
+            step = distributed_hybrid_step_mxu(
+                self.mesh, self.tf, spec, self.cfg.vdi, self.cfg.composite,
+                radius=self.cfg.sim.particle_radius * float(self._spacing[0]),
+                stamp=5)
+            r = self.cfg.render
+            slicer = self._slicer
+
+            @jax.jit
+            def warp(img, field, cam):
+                vol = Volume(field, self._origin, self._spacing)
+                axcam = slicer.make_axis_camera(vol, cam, spec)
+                return slicer.warp_to_camera(img, axcam, spec, cam,
+                                             r.width, r.height, r.background)
+
+            entry = (step, warp)
+            self._mxu_steps[("hybrid",) + regime] = entry
+        step, warp = entry
+        field = self.sim.field
+        vel = _vx.tracer_velocities(self.sim.flow.u, self.sim.tracers)
+        world = _vx.tracers_to_world(self.sim.tracers, self._origin,
+                                     self._spacing)
+        img, meta = step(shard_volume(field, self.mesh), self._origin,
+                         self._spacing, shard_particles(world, self.mesh),
+                         shard_particles(vel, self.mesh), self.camera)
+        return warp(img, field, self.camera), meta
 
     def _mxu_step(self):
         """Jitted MXU distributed step for the camera's current march
@@ -206,7 +371,8 @@ class InSituSession:
                                                     view_matrix)
         from scenery_insitu_tpu.core.vdi import VDIMetadata
         r = self.cfg.render
-        shape = np.asarray(self.sim.field.shape)
+        shape = (np.asarray(self.sim.field.shape)
+                 if hasattr(self.sim, "field") else np.zeros(3, np.int32))
         return VDIMetadata.create(
             projection=projection_matrix(self.camera, r.width, r.height),
             view=view_matrix(self.camera),

@@ -154,6 +154,47 @@ def project_divfree(u: jnp.ndarray, params: VortexParams,
                      ).astype(jnp.float32)
 
 
+def seed_tracers(grid: Tuple[int, int, int], n: int,
+                 seed: int = 0) -> jnp.ndarray:
+    """f32[N, 3] tracer positions in voxel coordinates (x, y, z), seeded
+    uniformly in the central half of the box (where the rings live)."""
+    d, h, w = grid
+    key = jax.random.PRNGKey(seed)
+    u01 = jax.random.uniform(key, (n, 3))
+    lo = jnp.array([w * 0.25, h * 0.25, d * 0.25], jnp.float32)
+    ext = jnp.array([w * 0.5, h * 0.5, d * 0.5], jnp.float32)
+    return lo + u01 * ext
+
+
+def tracer_velocities(u: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """Sample the flow velocity at tracer positions -> f32[N, 3] (vx,vy,vz
+    in voxel units/time). Periodic wrap via the same pad trick as
+    advect_semilagrangian; sample_trilinear expects [D, H, W] + (x,y,z)."""
+    def samp(f):
+        fp = jnp.pad(f, ((1, 1), (1, 1), (1, 1)), mode="wrap")
+        return sample_trilinear(fp, pos + 1.0)
+
+    return jnp.stack([samp(u[0]), samp(u[1]), samp(u[2])], axis=-1)
+
+
+def advect_tracers(u: jnp.ndarray, pos: jnp.ndarray,
+                   dt: jnp.ndarray) -> jnp.ndarray:
+    """Advect passive tracers through the flow (BASELINE.md Config 5's
+    500k-tracer hybrid). pos f32[N, 3] voxel coords (x, y, z); periodic
+    wrap. One forward-Euler step per call — the flow field is smooth and
+    the dt matches the solver's."""
+    _, d, h, w = u.shape
+    vel = tracer_velocities(u, pos)
+    box = jnp.array([w, h, d], jnp.float32)
+    return jnp.mod(pos + dt * vel, box)
+
+
+def tracers_to_world(pos: jnp.ndarray, origin: jnp.ndarray,
+                     spacing: jnp.ndarray) -> jnp.ndarray:
+    """Voxel-coordinate tracers -> world positions (x, y, z)."""
+    return origin + pos * spacing
+
+
 def step(flow: VortexFlow) -> VortexFlow:
     u = advect_semilagrangian(flow.u, flow.params.dt)
     u = project_divfree(u, flow.params)

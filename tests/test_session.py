@@ -71,3 +71,45 @@ def test_session_mxu_engine(tmp_path):
     assert np.isfinite(payload["vdi_color"]).all()
     assert int(payload["meta"].index) == 2
     assert len(s._mxu_steps) == 1
+
+
+def test_session_particle_mode():
+    cfg = _cfg(**{"sim.kind": "lennard_jones", "sim.num_particles": 64,
+                  "sim.particle_radius": 0.3})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    payload = sess.run(2)
+    assert payload["image"].shape == (4, 24, 32)
+    assert payload["depth"].shape == (24, 32)
+    assert np.isfinite(payload["image"]).all()
+
+
+def test_session_sho_mode():
+    cfg = _cfg(**{"sim.kind": "sho", "sim.num_particles": 32,
+                  "sim.particle_radius": 0.05})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    payload = sess.run(2)
+    assert payload["image"].shape == (4, 24, 32)
+
+
+def test_session_hybrid_mode():
+    cfg = _cfg(**{"sim.kind": "hybrid", "sim.num_particles": 64,
+                  "sim.particle_radius": 0.8,
+                  "slicer.engine": "mxu", "slicer.matmul_dtype": "f32"})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    payload = sess.run(2)
+    assert payload["image"].shape == (4, 24, 32)
+    assert np.isfinite(payload["image"]).all()
+
+
+def test_bad_env_override_raises(monkeypatch):
+    monkeypatch.setenv("SITPU_RENDER_WIDHT", "512")     # typo'd key
+    try:
+        FrameworkConfig.load()
+        raise AssertionError("typo'd SITPU_* key must raise")
+    except ValueError as e:
+        assert "WIDHT" in str(e)
+
+
+def test_env_override_applies(monkeypatch):
+    monkeypatch.setenv("SITPU_RENDER_WIDTH", "512")
+    assert FrameworkConfig.load().render.width == 512

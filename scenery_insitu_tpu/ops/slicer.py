@@ -274,7 +274,11 @@ def _interp_matrix(pos: jnp.ndarray, origin, spacing, n: int,
     """Banded bilinear interpolation weights for world positions ``pos
     [C, M]`` against voxel rows 0..n-1 → ``[C, M, n]``. Clamp-to-edge
     inside the volume extent, zero outside; `bounds` further restricts to a
-    half-open world interval (domain-decomposition ownership)."""
+    half-open world interval (domain-decomposition ownership).
+    ``origin``/``spacing`` may be scalars or per-chunk [C] arrays (the
+    novel-view renderer resamples slices whose grids scale per slice)."""
+    origin = jnp.reshape(origin, (-1, 1)) if jnp.ndim(origin) else origin
+    spacing = jnp.reshape(spacing, (-1, 1)) if jnp.ndim(spacing) else spacing
     x = (pos - origin) / spacing - 0.5
     valid = (x >= -0.5) & (x <= n - 0.5)
     if bounds is not None:
@@ -615,7 +619,13 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     color, depth = ss.finalize(state)
 
     dims = jnp.asarray(vol.dims_xyz, jnp.float32)
+    # model = voxel->world affine (diag spacing + origin): consumers that
+    # only get metadata (axis_camera_from_meta) read the per-axis pitch
+    # from here — nw alone is min(spacing), wrong for anisotropic volumes
+    model = jnp.diag(jnp.concatenate([vol.spacing, jnp.ones(1)]))
+    model = model.at[:3, 3].set(vol.origin)
     meta = VDIMetadata.create(projection=axcam.proj, view=axcam.view,
-                              volume_dims=dims, window_dims=(ni, nj),
+                              model=model, volume_dims=dims,
+                              window_dims=(ni, nj),
                               nw=nominal_step(vol), index=frame_index)
     return VDI(color, depth), meta, axcam

@@ -1,0 +1,297 @@
+"""MXU-native novel-view VDI rendering — the TPU-fast streamed-VDI client
+(≅ EfficientVDIRaycast.comp, the reference's 848-line novel-view renderer:
+per output pixel it marches the original camera's frustum grid, binary-
+searches each crossed pixel-list and intersects supersegments exactly,
+EfficientVDIRaycast.comp:110-141,173-190,274-450).
+
+The portable equivalent here (ops.vdi_render.render_vdi) re-imports the
+per-step gather pattern — the exact access pattern ops/slicer.py exists to
+avoid. This module re-derives novel-view VDI rendering as banded matmuls,
+exploiting a structural property of slice-march VDIs: their generating
+camera is a *virtual axis-aligned camera*, so
+
+1. the set of samples at original depth-ratio ``s`` lies on the world
+   plane ``w = const`` (the original march's own slice plane), and
+2. that plane carries a UNIFORM pixel grid — the original intermediate
+   grid scaled about the original eye by ``s``.
+
+So a VDI slice at depth s is an ordinary image (decoded from the per-pixel
+slab lists with an elementwise masked reduction over K — no gathers), its
+world footprint is a scale+shift of a uniform grid, and resampling it onto
+a new camera's ray bundle at the same plane is the SAME separable banded-
+matmul machinery the forward march uses. Novel-view rendering = march the
+original slice planes in the new camera's front-to-back order, resample
+each decoded slice, alpha-under accumulate, homography-warp to the display
+camera. The march is gather-free end to end.
+
+Validity: the new camera must march the same volume axis as the VDI's
+generating camera (``slicer.choose_axis(new_cam)[0] == spec.axis``) — the
+same per-regime constraint the forward engine has. Either sign works (the
+plane stack is composited in the new camera's order). Opacity is corrected
+per-pixel by the ratio of the new ray's inter-plane path length to the
+original one (both resampled alongside the color planes), the same
+traversed-fraction law the rest of the framework uses.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
+from scenery_insitu_tpu.ops import slicer
+from scenery_insitu_tpu.ops.sampling import adjust_opacity
+from scenery_insitu_tpu.ops.slicer import (AxisCamera, AxisSpec,
+                                           _interp_matrix, make_axis_camera,
+                                           warp_to_camera)
+
+
+def axis_camera_from_meta(meta: VDIMetadata, spec: AxisSpec) -> AxisCamera:
+    """Reconstruct the generating virtual axis camera of a slice-march VDI
+    from its metadata (for stored/streamed VDIs whose AxisCamera wasn't
+    shipped; ≅ the reference hardcoding original-camera matrices into
+    EfficientVDIRaycast.comp:584-606).
+
+    The slice pitch comes from ``meta.model``'s diagonal (the voxel->world
+    affine the generator stores); only ``w0`` is approximate when the eye
+    sat inside the volume along the march axis (make_axis_camera clamps zp
+    to one voxel there, and the clamp is not recoverable from metadata)."""
+    view = meta.view
+    proj = meta.projection
+    rot = view[:3, :3]
+    eye = -rot.T @ view[:3, 3]
+    a, ua, va = spec.axis, spec.u_axis, spec.v_axis
+
+    # standard frustum: proj[0,0]=2n/(r-l), proj[0,2]=(r+l)/(r-l), ...
+    zp = proj[2, 3] / (proj[2, 2] - 1.0)                   # = near
+    rl = 2.0 * zp / proj[0, 0]                             # r - l
+    tb = 2.0 * zp / proj[1, 1]                             # t - b
+    rpl = proj[0, 2] * rl                                  # r + l
+    tpb = proj[1, 2] * tb                                  # t + b
+
+    ni, nj = spec.ni, spec.nj
+    # virtual basis: fwd = sign * axis; right/up from the same cross
+    # products make_axis_camera uses
+    import numpy as np
+    fwd = np.zeros(3, np.float32)
+    fwd[a] = spec.sign
+    up = np.zeros(3, np.float32)
+    up[va] = 1.0
+    right = np.cross(fwd, up)
+    true_up = np.cross(right, fwd)
+    right_u = float(right[ua])
+    up_v = float(true_up[va])
+
+    ndc_x = (jnp.arange(ni, dtype=jnp.float32) + 0.5) / ni * 2 - 1
+    ndc_y = 1.0 - (jnp.arange(nj, dtype=jnp.float32) + 0.5) / nj * 2
+    u_grid = eye[ua] + (ndc_x * rl + rpl) * 0.5 * right_u
+    v_grid = eye[va] + (ndc_y * tb + tpb) * 0.5 * up_v
+
+    # per-axis pitch from the voxel->world model; identity model = legacy
+    # metadata without placement, fall back to nw (exact for cubic voxels)
+    legacy = jnp.all(jnp.abs(meta.model - jnp.eye(4)) < 1e-12)
+    dw = jnp.where(legacy, meta.nw, meta.model[a, a])
+    w0 = eye[a] + jnp.float32(spec.sign) * zp
+    far = proj[2, 3] / (proj[2, 2] + 1.0)
+    return AxisCamera(
+        eye_uvw=jnp.stack([eye[ua], eye[va], eye[a]]),
+        view=view, proj=proj, u_grid=u_grid, v_grid=v_grid,
+        zp=zp, w0=w0, dwm=jnp.float32(spec.sign) * dw, far=far)
+
+
+def decode_slice(vdi: VDI, t: jnp.ndarray, dt_ref: jnp.ndarray
+                 ) -> jnp.ndarray:
+    """Decode the VDI at per-pixel depths ``t [C, Nj, Ni]`` into per-step
+    source planes ``[C, 5, Nj, Ni]``: premultiplied step rgb (3), step
+    alpha for traversing ``dt_ref`` (1), and dt_ref itself (1) so the
+    consumer can re-correct opacity for ITS path length after resampling.
+    Elementwise masked reduction over the K slabs — no gathers."""
+    starts = vdi.depth[:, 0]                               # [K, Nj, Ni]
+    ends = vdi.depth[:, 1]
+    inside = (t[:, None] >= starts[None]) & (t[:, None] < ends[None])
+    insf = inside.astype(jnp.float32)                      # [C, K, Nj, Ni]
+    rgba = jnp.einsum("ckji,kdji->cdji", insf, vdi.color)  # [C, 4, Nj, Ni]
+    length = jnp.einsum("ckji,kji->cji", insf,
+                        jnp.where(jnp.isfinite(ends - starts),
+                                  ends - starts, 0.0))
+    a_slab = jnp.clip(rgba[:, 3], 0.0, 1.0 - 1e-6)
+    frac = dt_ref / jnp.maximum(length, 1e-6)
+    a_step = adjust_opacity(a_slab, jnp.minimum(frac, 1.0))
+    a_step = jnp.where(length > 0.0, a_step, 0.0)
+    scale = a_step / jnp.maximum(a_slab, 1e-6)
+    rgb_step = rgba[:, :3] * scale[:, None]
+    return jnp.concatenate([rgb_step, a_step[:, None], dt_ref[:, None]],
+                           axis=1)
+
+
+def render_vdi_mxu(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
+                   cam: Camera, width: int, height: int,
+                   num_slices: Optional[int] = None,
+                   spec_new: Optional[AxisSpec] = None,
+                   background: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0),
+                   early_exit_alpha: float = 0.999,
+                   axis_sign: Optional[Tuple[int, int]] = None
+                   ) -> jnp.ndarray:
+    """Render a slice-march VDI from a new camera -> f32[4, H, W]
+    premultiplied. Gather-free: per original slice plane, decode + two
+    banded resampling matmuls + alpha-under fold.
+
+    ``num_slices``: STATIC number of planes to march. The default estimates
+    the original march's slice count from the intermediate grid size
+    (``ni0 / scale`` — grids are sized ~1.25x the in-plane voxel count and
+    volumes are roughly cubic); pass the real slice count (the generating
+    volume's extent along the march axis, in voxels) when you have it —
+    too few planes truncates the far content.
+    ``spec_new``: static spec for the new camera's intermediate grid.
+    ``axis_sign``: the new camera's march regime; REQUIRED when ``cam`` is
+    traced inside jit (the default calls ``slicer.choose_axis``, which
+    needs a concrete eye).
+    """
+    k, _, nj0, ni0 = vdi.color.shape
+    axis = spec0.axis
+    new_axis, new_sign = axis_sign or slicer.choose_axis(cam)
+    if new_axis != axis:
+        raise ValueError(
+            f"novel view marches axis {new_axis} but the VDI was generated "
+            f"along axis {axis}; use ops.vdi_render.render_vdi for "
+            "cross-regime views")
+    if spec_new is None:
+        # the new frustum must cover the original one's far-plane footprint
+        # (bigger than the near-plane one by the depth-ratio range), so give
+        # the intermediate grid proportionally more pixels or the resample
+        # blurs even for the identity view
+        rnd = lambda n: max(8, -(-int(n) // 8) * 8)
+        spec_new = AxisSpec(axis=axis, sign=new_sign,
+                            ni=rnd(ni0 * 1.75), nj=rnd(nj0 * 1.75),
+                            chunk=spec0.chunk,
+                            matmul_dtype=spec0.matmul_dtype)
+
+    # depth ladder: the original march's slice planes (count must be
+    # static; see docstring for the default heuristic)
+    if num_slices is None:
+        num_slices = max(16, int(round(ni0 / 1.25)))
+    s_count = num_slices
+
+    eu0, ev0, ew0 = axcam0.eye_u, axcam0.eye_v, axcam0.eye_w
+    length0 = axcam0.ray_lengths()                         # [Nj0, Ni0]
+    ds0 = jnp.abs(axcam0.dwm) / axcam0.zp
+
+    # new virtual camera over the same world box footprint: derive the box
+    # from the original grid's extent at s=1 … use the original reference
+    # plane's footprint propagated to the new camera via make_axis_camera
+    # on a synthetic volume is awkward — build the new grid directly from
+    # the original one's world extent (the content cannot leave the
+    # original frustum anyway).
+    du0 = axcam0.u_grid[1] - axcam0.u_grid[0]
+    dv0 = axcam0.v_grid[1] - axcam0.v_grid[0]
+
+    # world w of original slice plane q (q ascending = original march
+    # front-to-back); new camera visits them in its own order
+    def plane_w(q):
+        return axcam0.w0 + q * axcam0.dwm
+
+    same_dir = (spec_new.sign == spec0.sign)
+    # new-order index -> original plane index
+    def orig_index(qn):
+        return qn if same_dir else (s_count - 1.0 - qn)
+
+    # new camera geometry: reuse make_axis_camera by synthesizing the
+    # content AABB in world space from the original frustum's footprint
+    # over the VDI's ACTUAL depth range (traced values may size the box —
+    # only the pixel counts must stay static); a loose box wastes
+    # intermediate resolution and blurs the resample
+    ends = vdi.depth[:, 1]
+    s_of_end = jnp.where(jnp.isfinite(ends), ends, 0.0) / length0[None]
+    smax = jnp.clip(jnp.max(s_of_end), 1.0, 1.0 + ds0 * s_count)
+    u_lo = jnp.minimum(axcam0.u_grid[0], eu0 + (axcam0.u_grid[0] - eu0) * smax)
+    u_hi = jnp.maximum(axcam0.u_grid[-1], eu0 + (axcam0.u_grid[-1] - eu0) * smax)
+    v_vals = jnp.stack([axcam0.v_grid[0], axcam0.v_grid[-1],
+                        ev0 + (axcam0.v_grid[0] - ev0) * smax,
+                        ev0 + (axcam0.v_grid[-1] - ev0) * smax])
+    v_lo, v_hi = jnp.min(v_vals), jnp.max(v_vals)
+    w_far = ew0 + jnp.float32(spec0.sign) * smax * axcam0.zp
+    w_lo = jnp.minimum(plane_w(0.0), w_far)
+    w_hi = jnp.maximum(plane_w(0.0), w_far)
+
+    box_min = jnp.zeros(3).at[spec0.u_axis].set(u_lo) \
+        .at[spec0.v_axis].set(v_lo).at[axis].set(w_lo)
+    box_max = jnp.zeros(3).at[spec0.u_axis].set(u_hi) \
+        .at[spec0.v_axis].set(v_hi).at[axis].set(w_hi)
+
+    from scenery_insitu_tpu.core.volume import Volume
+    # the dummy volume only feeds make_axis_camera's spacing reads (slice
+    # pitch, footprint margins, zp floor) — give it the ORIGINAL grid's
+    # pitches, not a box-sized spacing that would inflate all three
+    sp = jnp.zeros(3).at[spec0.u_axis].set(jnp.abs(du0)) \
+        .at[spec0.v_axis].set(jnp.abs(dv0)).at[axis].set(jnp.abs(axcam0.dwm))
+    dummy = Volume(jnp.zeros((2, 2, 2), jnp.float32), box_min, sp)
+    axcam_n = make_axis_camera(dummy, cam, spec_new,
+                               box_min=box_min, box_max=box_max)
+
+    eun, evn, ewn = axcam_n.eye_u, axcam_n.eye_v, axcam_n.eye_w
+    length_n = axcam_n.ray_lengths()                       # [Njn, Nin]
+    mm = jnp.bfloat16 if spec_new.matmul_dtype == "bf16" else jnp.float32
+
+    c = spec_new.chunk
+    nchunks = -(-s_count // c)
+
+    def body(carry, ci):
+        qn = ci * c + jnp.arange(c, dtype=jnp.float32)     # new-order idx
+        live = qn < s_count
+        q0 = orig_index(qn)                                # original idx
+        wq = plane_w(q0)                                   # [C] plane w
+
+        # original grid on this plane: scale s0 about the original eye
+        s0 = jnp.float32(spec0.sign) * (wq - ew0) / axcam0.zp
+        t_at = s0[:, None, None] * length0[None]           # [C, Nj0, Ni0]
+        dt0 = ds0 * length0                                # per-step len
+        src = decode_slice(vdi, t_at, jnp.broadcast_to(dt0, t_at.shape))
+
+        # source grid origin/spacing on the plane (uniform, per slice)
+        su_org = eu0 + (axcam0.u_grid[0] - eu0) * s0       # [C]
+        su_sp = du0 * s0
+        sv_org = ev0 + (axcam0.v_grid[0] - ev0) * s0
+        sv_sp = dv0 * s0
+
+        # new camera's sample positions on the plane
+        sn = jnp.float32(spec_new.sign) * (wq - ewn) / axcam_n.zp
+        pos_u = eun + (axcam_n.u_grid[None, :] - eun) * sn[:, None]
+        pos_v = evn + (axcam_n.v_grid[None, :] - evn) * sn[:, None]
+        front = sn > spec_new.s_floor                      # plane before eye
+
+        wu = _interp_matrix(pos_u, su_org, su_sp, ni0)     # [C, Nin, Ni0]
+        wv = _interp_matrix(pos_v, sv_org, sv_sp, nj0)     # [C, Njn, Nj0]
+
+        val = jnp.einsum("cjy,cdyx,cix->cdji",
+                         wv.astype(mm), src.astype(mm), wu.astype(mm),
+                         preferred_element_type=jnp.float32)
+        rgb = val[:, :3]
+        a_res = jnp.clip(val[:, 3], 0.0, 1.0 - 1e-6)
+        dt0_res = val[:, 4]
+
+        # re-correct opacity for the NEW ray's inter-plane path length:
+        # planes are |dwm| apart in w; a new ray whose eye-to-refplane
+        # distance is length_n crosses them every |dwm|·length_n/zp_n
+        dtn = jnp.abs(axcam0.dwm) / axcam_n.zp * length_n  # [Njn, Nin]
+        ratio = dtn[None] / jnp.maximum(dt0_res, 1e-6)
+        a_new = adjust_opacity(a_res, jnp.clip(ratio, 0.0, 16.0))
+        gate = (live & front)[:, None, None].astype(jnp.float32)
+        a_new = a_new * gate
+        scale = a_new / jnp.maximum(a_res, 1e-6)
+        rgb_new = rgb * scale[:, None]
+
+        acc = carry
+        for i in range(c):
+            pgate = (acc[3] < early_exit_alpha).astype(jnp.float32)
+            srcp = jnp.concatenate([rgb_new[i], a_new[i][None]]) * pgate[None]
+            acc = acc + (1.0 - acc[3:4]) * srcp
+        return acc, None
+
+    acc0 = jnp.zeros((4, spec_new.nj, spec_new.ni), jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, jnp.arange(nchunks))
+
+    return warp_to_camera(acc, axcam_n, spec_new, cam, width, height,
+                          background)

@@ -1,0 +1,179 @@
+"""Distributed per-phase breakdown (VERDICT weak #6): the production frame
+is ONE jitted SPMD program (by design — XLA overlaps generate, all_to_all,
+composite), so the session's timers can only see dispatch+fetch. This
+diagnostic splits the chain into separately-jitted stages with
+block_until_ready between them — the TPU analog of the reference's
+per-phase timer taxonomy (total / all_to_all / composite / gather,
+DistributedVolumeRenderer.kt:622-648). The split forces materialization
+between stages, so the SUM here is an upper bound on the fused frame time
+(also printed for comparison).
+
+Inputs are chained across iterations so no execution-dedup layer can fake
+the timings. Runs on the virtual CPU mesh by default; SITPU_BENCH_REAL=1
+uses real devices.
+
+Usage: python benchmarks/phase_bench.py [--ranks 8] [--grid 64] [--iters 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = "_SITPU_PHASEBENCH_CHILD"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=8)
+    ap.add_argument("--grid", type=int, default=64)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--sim-steps", type=int, default=5)
+    args = ap.parse_args()
+    n = args.ranks
+
+    if os.environ.get(_CHILD) != "1" and os.environ.get(
+            "SITPU_BENCH_REAL") != "1":
+        env = dict(os.environ)
+        env[_CHILD] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+    import jax
+
+    if os.environ.get(_CHILD) == "1":
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge as _xb
+
+            _xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from scenery_insitu_tpu.config import (CompositeConfig, SliceMarchConfig,
+                                           VDIConfig)
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.ops.composite import composite_vdis
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.parallel.pipeline import (_exchange_columns,
+                                                      _mxu_rank_generate,
+                                                      distributed_vdi_step_mxu,
+                                                      shard_volume)
+    from scenery_insitu_tpu.sim import grayscott as gs
+
+    mesh = make_mesh(n)
+    axis = mesh.axis_names[0]
+    g = args.grid
+    tf = for_dataset("gray_scott")
+    cam = Camera.create((0.0, 0.5, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
+    vdi_cfg = VDIConfig(max_supersegments=args.k, adaptive_iters=2)
+    comp_cfg = CompositeConfig(max_output_supersegments=args.k,
+                               adaptive_iters=2)
+    mcfg = SliceMarchConfig(
+        matmul_dtype="f32" if jax.default_backend() != "tpu" else "bf16")
+    spec = slicer.make_spec(cam, (g, g, g), mcfg, multiple_of=n)
+
+    origin = jnp.array([-1.0, -1.0, -1.0], jnp.float32)
+    spacing = jnp.full((3,), 2.0 / g, jnp.float32)
+
+    # --------------------------------------------------- split-stage fns
+    sim_fn = jax.jit(lambda u, v: gs.multi_step(
+        gs.GrayScott(u, v, gs.GrayScottParams.create()), args.sim_steps))
+
+    def gen(local, o, s, c):
+        vdi, meta, _ = _mxu_rank_generate(local, o, s, c, slicer, spec, tf,
+                                          vdi_cfg, axis, n)
+        return vdi.color, vdi.depth
+
+    gen_fn = jax.jit(jax.shard_map(
+        gen, mesh=mesh, in_specs=(P(axis, None, None), P(), P(), P()),
+        out_specs=(P(axis), P(axis)), check_vma=False))
+
+    def exch(color, depth):
+        return (_exchange_columns(color, n, axis),
+                _exchange_columns(depth, n, axis))
+
+    exch_fn = jax.jit(jax.shard_map(
+        exch, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)), check_vma=False))
+
+    def comp(colors, depths):
+        out = composite_vdis(colors, depths, comp_cfg)
+        return out.color, out.depth
+
+    comp_fn = jax.jit(jax.shard_map(
+        comp, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(None, None, None, axis), P(None, None, None, axis)),
+        check_vma=False))
+
+    fused = distributed_vdi_step_mxu(mesh, tf, spec, vdi_cfg, comp_cfg)
+
+    st = gs.GrayScott.init((g, g, g))
+    u = shard_volume(st.u, mesh)
+    v = shard_volume(st.v, mesh)
+
+    phases = {k: 0.0 for k in
+              ("sim", "generate", "all_to_all", "composite", "gather",
+               "fused_total")}
+
+    def tick(key, fn, *a):
+        t0 = time.perf_counter()
+        out = fn(*a)
+        jax.block_until_ready(out)
+        phases[key] += time.perf_counter() - t0
+        return out
+
+    # warm up every stage
+    stw = sim_fn(u, v)
+    cw, dw = gen_fn(stw.v, origin, spacing, cam)
+    ce, de = exch_fn(cw, dw)
+    comp_out = comp_fn(ce, de)
+    fused_out = fused(stw.v, origin, spacing, cam)
+    jax.block_until_ready((comp_out, fused_out))
+
+    for it in range(args.iters):
+        stp = tick("sim", sim_fn, u, v)
+        u, v = stp.u, stp.v
+        c, d = tick("generate", gen_fn, v, origin, spacing, cam)
+        ce, de = tick("all_to_all", exch_fn, c, d)
+        oc, od = tick("composite", comp_fn, ce, de)
+        t0 = time.perf_counter()
+        host = (jnp.asarray(oc).block_until_ready()
+                if hasattr(oc, "block_until_ready") else oc)
+        import numpy as _np
+        _np.asarray(host)
+        phases["gather"] += time.perf_counter() - t0
+        vdi_f, _ = tick("fused_total", fused, v, origin, spacing, cam)
+
+    ms = {k: round(t / args.iters * 1000, 2) for k, t in phases.items()}
+    split_sum = sum(v for k, v in ms.items()
+                    if k not in ("fused_total",))
+    print(json.dumps({
+        "metric": f"phase_breakdown_{n}ranks_{g}c",
+        "unit": "ms/frame",
+        "phases": ms,
+        "split_sum_ms": round(split_sum, 2),
+        "fused_ms": ms["fused_total"],
+        "overlap_gain": round(split_sum / max(ms["fused_total"], 1e-9), 2),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

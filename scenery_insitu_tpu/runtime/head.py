@@ -1,0 +1,175 @@
+"""The head/display node — a standalone viewer process assembling remote
+render ranks' images (≅ Head.kt: a master node that receives each rank's
+color+depth planes, binds them as ColorBuffer$rank/DepthBuffer$rank and
+min-depth composites on a fullscreen quad, Head.kt:40-183 +
+NaiveCompositor.frag:15-28; its camera moves are published back over ZMQ,
+Head.kt:137-161).
+
+Here the head is transport + numpy: render ranks PUSH ``[msgpack header |
+image blob | depth blob]`` per frame (``RankImageSender``), the head
+collects one set per frame index, depth-min composites
+(ops.composite.composite_depth_min semantics, done in numpy — the head
+node owns no accelerator), and hands frames to sinks (PNG, movie, live
+UDP video). Steering messages go back through the ordinary
+SteeringPublisher → SteeringRelay → render ranks chain.
+
+Run standalone:  python -m scenery_insitu_tpu.runtime.head --ranks 2
+                 [--bind tcp://*:6677] [--frames 10] [--out dir/]
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from scenery_insitu_tpu.runtime.streaming import _msgpack, _zmq
+
+Sink = Callable[[int, dict], None]
+
+
+class RankImageSender:
+    """Render-rank side: push this rank's (image, depth) per frame to the
+    head (≅ the MPI iSend of image planes the reference's ranks did,
+    SharedSpheresExample.kt:174-207 / scenery's client mode)."""
+
+    def __init__(self, rank: int, connect: str = "tcp://localhost:6677"):
+        zmq = _zmq()
+        self.rank = rank
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PUSH)
+        self.sock.connect(connect)
+
+    def send(self, frame: int, image: np.ndarray, depth: np.ndarray) -> None:
+        """image f32[4, H, W] premultiplied; depth f32[H, W] (+inf empty)."""
+        image = np.ascontiguousarray(image, np.float32)
+        depth = np.ascontiguousarray(depth, np.float32)
+        header = _msgpack().packb({
+            "rank": self.rank, "frame": int(frame),
+            "image_shape": list(image.shape),
+            "depth_shape": list(depth.shape)})
+        self.sock.send_multipart([header, image.tobytes(), depth.tobytes()])
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+def depth_min_composite_np(images: List[np.ndarray],
+                           depths: List[np.ndarray]
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-pixel nearest-rank pick (numpy twin of ops.composite
+    .composite_depth_min; ≅ NaiveCompositor.frag:15-28)."""
+    imgs = np.stack(images)                                # [n, 4, H, W]
+    deps = np.stack(depths)                                # [n, H, W]
+    idx = np.argmin(deps, axis=0)                          # [H, W]
+    img = np.take_along_axis(imgs, idx[None, None], axis=0)[0]
+    dep = np.take_along_axis(deps, idx[None], axis=0)[0]
+    return img, dep
+
+
+class HeadNode:
+    """Collect per-rank frames, composite complete sets, feed sinks."""
+
+    def __init__(self, num_ranks: int, bind: str = "tcp://*:6677",
+                 sinks: Tuple[Sink, ...] = (), stale_frames: int = 8):
+        zmq = _zmq()
+        self.n = num_ranks
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PULL)
+        if bind.endswith(":0"):
+            port = self.sock.bind_to_random_port(bind[:-2])
+            self.endpoint = f"{bind[:-2].replace('*', '127.0.0.1')}:{port}"
+        else:
+            self.sock.bind(bind)
+            self.endpoint = bind.replace("*", "127.0.0.1")
+        self.sinks = list(sinks)
+        self.stale_frames = stale_frames
+        self._pending: Dict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]] = {}
+        self.frames_composited = 0
+        self.latest: Optional[np.ndarray] = None
+
+    def pump(self, timeout_ms: int = 100) -> int:
+        """Receive pending rank messages; composite every completed frame
+        set; returns number of frames composited this call."""
+        zmq = _zmq()
+        done = 0
+        while self.sock.poll(timeout_ms):
+            header, iblob, dblob = self.sock.recv_multipart()
+            h = _msgpack().unpackb(header)
+            img = np.frombuffer(iblob, np.float32).reshape(h["image_shape"])
+            dep = np.frombuffer(dblob, np.float32).reshape(h["depth_shape"])
+            frame = h["frame"]
+            self._pending.setdefault(frame, {})[h["rank"]] = (img, dep)
+            if len(self._pending[frame]) == self.n:
+                ranks = self._pending.pop(frame)
+                imgs = [ranks[r][0] for r in sorted(ranks)]
+                deps = [ranks[r][1] for r in sorted(ranks)]
+                out, dmin = depth_min_composite_np(imgs, deps)
+                self.latest = out
+                self.frames_composited += 1
+                done += 1
+                payload = {"image": out, "depth": dmin, "frame": frame}
+                for s in self.sinks:
+                    s(frame, payload)
+                # drop stragglers that can never complete
+                for old in [f for f in self._pending
+                            if f < frame - self.stale_frames]:
+                    del self._pending[old]
+            timeout_ms = 0                                 # drain non-blocking
+        return done
+
+    def run(self, frames: int, timeout_s: float = 60.0) -> int:
+        """Pump until ``frames`` sets composited or timeout; returns count."""
+        t0 = time.monotonic()
+        while (self.frames_composited < frames
+               and time.monotonic() - t0 < timeout_s):
+            self.pump(timeout_ms=100)
+        return self.frames_composited
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+def head_sender_sink(sender: RankImageSender) -> Sink:
+    """Session sink forwarding plain/particle frames to the head node
+    (payloads with image+depth — the particle and plain modes)."""
+
+    def sink(index: int, payload: dict) -> None:
+        if "image" in payload and "depth" in payload:
+            sender.send(index, payload["image"], payload["depth"])
+
+    return sink
+
+
+if __name__ == "__main__":
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=2)
+    ap.add_argument("--bind", default="tcp://*:6677")
+    ap.add_argument("--frames", type=int, default=10)
+    ap.add_argument("--out", default=None, help="PNG output directory")
+    ap.add_argument("--video-port", type=int, default=0,
+                    help="also stream composited frames over UDP")
+    args = ap.parse_args()
+
+    sinks = []
+    if args.out:
+        from scenery_insitu_tpu.utils.image import save_png
+
+        os.makedirs(args.out, exist_ok=True)
+        sinks.append(lambda i, p: save_png(
+            os.path.join(args.out, f"head{i:05d}.png"), p["image"]))
+    if args.video_port:
+        from scenery_insitu_tpu.runtime.streaming import (VideoStreamer,
+                                                          live_video_sink)
+
+        sinks.append(live_video_sink(VideoStreamer(port=args.video_port)))
+
+    head = HeadNode(args.ranks, args.bind, tuple(sinks))
+    print(f"[head] listening on {head.endpoint} for {args.ranks} ranks",
+          flush=True)
+    got = head.run(args.frames)
+    print(f"[head] composited {got} frames", flush=True)

@@ -65,14 +65,14 @@ def _peak_flops(device_kind: str, platform: str):
     return 197.0e12  # assume v5e-class if unrecognized
 
 
-def _slice_march_flops(spec, grid: int, ad_iters: int) -> float:
-    """Matmul FLOPs of one frame of the MXU engine: (ad_iters counting
-    marches + 1 write march) × grid slices × the two banded resampling
+def _slice_march_flops(spec, grid: int, marches: int) -> float:
+    """Matmul FLOPs of one frame of the MXU engine: ``marches`` full
+    marches (counting + write) × grid slices × the two banded resampling
     matmuls per slice ([Nj,Nv]@[Nv,Nu] then @[Nu,Ni]ᵀ). Elementwise work
     (sim stencil, TF, supersegment folds) excluded — matmul-only MFU."""
     nv = nu = grid  # in-plane voxel counts (cubic grid)
     per_slice = 2.0 * spec.nj * nu * (nv + spec.ni)
-    return (ad_iters + 1) * grid * per_slice
+    return marches * grid * per_slice
 
 
 def main():
@@ -92,6 +92,9 @@ def main():
     frames = _env_int("SITPU_BENCH_FRAMES", 5)
     sim_steps = _env_int("SITPU_BENCH_SIM_STEPS", 10)
     ad_iters = _env_int("SITPU_BENCH_ADAPTIVE_ITERS", 2)
+    # histogram: ONE counting march for all candidate thresholds (higher
+    # segment fidelity than a 2-iter search AND fewer marches)
+    ad_mode = os.environ.get("SITPU_BENCH_ADAPTIVE_MODE", "histogram")
 
     dev = jax.devices()[0]
     platform = dev.platform
@@ -106,7 +109,8 @@ def main():
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
     frame_step = grayscott_vdi_frame_step(
         width, height, sim_steps=sim_steps, max_steps=steps,
-        vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters),
+        vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,
+                          adaptive_mode=ad_mode),
         comp_cfg=CompositeConfig(max_output_supersegments=k,
                                  adaptive_iters=ad_iters),
         engine=engine, grid_shape=(grid, grid, grid),
@@ -148,8 +152,9 @@ def main():
         spec = slicer.make_spec(base, (grid, grid, grid), SliceMarchConfig())
         render_cfg = {"image": [spec.ni, spec.nj], "steps": grid}
         res_tag = f"{spec.ni}x{spec.nj}"
+        marches = 2 if ad_mode == "histogram" else ad_iters + 1
         if peak:
-            mfu = round(_slice_march_flops(spec, grid, ad_iters) * fps / peak,
+            mfu = round(_slice_march_flops(spec, grid, marches) * fps / peak,
                         5)
     else:
         render_cfg = {"image": [width, height], "steps": steps}
@@ -163,7 +168,8 @@ def main():
         "mfu_matmul": mfu,
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
-                   "adaptive_iters": ad_iters, "compile_s": round(compile_s, 1),
+                   "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
+                   "compile_s": round(compile_s, 1),
                    "platform": platform, "device": dev.device_kind,
                    "assumed_peak_tflops": (peak / 1e12 if peak else None),
                    "engine": engine},
@@ -234,15 +240,9 @@ def _orchestrate():
 if __name__ == "__main__":
     if os.environ.get(_CHILD_MARKER) == "1":
         if os.environ.get("_SITPU_POP_AXON") == "1":
-            import jax
+            from scenery_insitu_tpu.utils.backend import pin_cpu_backend
 
-            jax.config.update("jax_platforms", "cpu")
-            try:
-                from jax._src import xla_bridge as _xb
-
-                _xb._backend_factories.pop("axon", None)
-            except Exception:
-                pass
+            pin_cpu_backend()
         try:
             main()
         except Exception:

@@ -40,16 +40,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _CHILD = "_SITPU_COMPBENCH_CHILD"
 
 
-def _reexec_virtual_mesh(n: int) -> None:
-    """Re-exec with an n-device virtual CPU platform (axon shim popped)."""
-    env = dict(os.environ)
-    env[_CHILD] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}").strip()
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+from scenery_insitu_tpu.utils.backend import (pin_cpu_backend,  # noqa: E402
+                                              reexec_virtual_mesh)
 
 
 def build_fixtures(n: int, grid: int, width: int, height: int, k: int,
@@ -106,20 +98,12 @@ def main():
 
     if os.environ.get(_CHILD) != "1" and os.environ.get(
             "SITPU_BENCH_REAL") != "1":
-        _reexec_virtual_mesh(n)
+        reexec_virtual_mesh(n, _CHILD)
 
     import jax
 
     if os.environ.get(_CHILD) == "1":
-        # env vars alone do NOT stop the axon TPU shim from hanging backend
-        # lookup when the tunnel is down — drop its factory too
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            from jax._src import xla_bridge as _xb
-
-            _xb._backend_factories.pop("axon", None)
-        except Exception:
-            pass
+        pin_cpu_backend()
     import jax.numpy as jnp
     import numpy as np
 

@@ -46,6 +46,18 @@ class VDIConfig:
     adaptive: bool = True
     adaptive_iters: int = 6         # binary search iterations when adaptive
     adaptive_delta: float = 0.15    # accept counts in [K*(1-delta), K]
+    # "search": adaptive_iters counting marches (binary search).
+    # "histogram": ONE counting march evaluating histogram_bins candidate
+    # thresholds simultaneously (possible because the break metric compares
+    # consecutive items — see ops/supersegments.py) then pick per pixel.
+    adaptive_mode: str = "search"
+    histogram_bins: int = 16
+
+    def __post_init__(self):
+        if self.adaptive_mode not in ("search", "histogram"):
+            raise ValueError(
+                f"adaptive_mode must be 'search' or 'histogram', "
+                f"got {self.adaptive_mode!r}")
     # Occupancy grid (≅ OctreeCells r32ui [W/8, H/8, K]): cell size in pixels.
     occupancy_cell: int = 8
 

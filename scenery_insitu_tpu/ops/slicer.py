@@ -598,7 +598,19 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
         occupancy=occ)
 
-    if cfg.adaptive:
+    if cfg.adaptive and cfg.adaptive_mode == "histogram":
+        # one counting march for ALL candidate thresholds at once
+        tvec = ss.threshold_candidates(cfg.histogram_bins)
+
+        def consume_multi(st, rgba, t0, t1):
+            for i in range(rgba.shape[0]):
+                st = ss.push_count_multi(st, tvec, rgba[i])
+            return st
+
+        counts = march(consume_multi,
+                       ss.init_count_multi(cfg.histogram_bins, nj, ni)).counts
+        threshold = ss.pick_threshold(counts, tvec, k)
+    elif cfg.adaptive:
         def count_fn(thr):
             def consume(st, rgba, t0, t1):
                 for i in range(rgba.shape[0]):

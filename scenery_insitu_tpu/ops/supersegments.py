@@ -158,15 +158,27 @@ def init_count(height: int, width: int) -> CountState:
                       jnp.full((height, width), -jnp.inf, jnp.float32))
 
 
+def _start_mask(prev_rgb, prev_empty, prev_end, rgba, thr, t0,
+                gap_eps: float):
+    """Segment-START predicate shared by every counting variant. ``thr``
+    is anything broadcastable against [H, W] (per-pixel [H, W], scalar, or
+    candidate stack [B, 1, 1]). Returns (starts, is_empty)."""
+    is_empty = rgba[3] < EMPTY_ALPHA
+    diff = jnp.linalg.norm(rgba[:3] - prev_rgb, axis=0)
+    starts = ~is_empty & (prev_empty | (diff > thr))
+    if gap_eps >= 0.0 and t0 is not None:
+        starts = starts | (~is_empty & ~prev_empty
+                           & (t0 > prev_end + gap_eps))
+    return starts, is_empty
+
+
 def push_count(state: CountState, threshold: jnp.ndarray,
                rgba: jnp.ndarray, t0: jnp.ndarray = None,
                t1: jnp.ndarray = None, gap_eps: float = -1.0) -> CountState:
     """O(1)-state counterpart of `push`: counts segment *starts*."""
-    is_empty = rgba[3] < EMPTY_ALPHA
-    diff = jnp.linalg.norm(rgba[:3] - state.prev_rgb, axis=0)
-    starts = ~is_empty & (state.prev_empty | (diff > threshold))
-    if gap_eps >= 0.0 and t0 is not None:
-        starts |= ~is_empty & ~state.prev_empty & (t0 > state.prev_end + gap_eps)
+    starts, is_empty = _start_mask(state.prev_rgb, state.prev_empty,
+                                   state.prev_end, rgba, threshold, t0,
+                                   gap_eps)
     prev_end = state.prev_end if t1 is None else \
         jnp.where(is_empty, state.prev_end, t1)
     return CountState(state.count + starts.astype(jnp.int32),
@@ -192,3 +204,67 @@ def adaptive_threshold(count_fn: Callable[[jnp.ndarray], jnp.ndarray],
 
     lo, hi = jax.lax.fori_loop(0, iters, body, (lo, hi))
     return hi
+
+
+# --------------------------------------------- one-pass histogram threshold
+
+def threshold_candidates(bins: int, thr_max: float = 2.0,
+                         octaves: float = 8.0) -> jnp.ndarray:
+    """f32[B] ascending candidate thresholds: 0 (maximal segmentation)
+    followed by log spacing over ``octaves`` doublings up to thr_max —
+    small thresholds matter most (they control fine segmentation)."""
+    import numpy as np
+
+    t = np.geomspace(thr_max / 2.0 ** octaves, thr_max, bins - 1)
+    return jnp.asarray(np.concatenate([[0.0], t]), jnp.float32)
+
+
+class MultiCountState(NamedTuple):
+    """Counting fold evaluated at ALL candidate thresholds at once. The
+    break metric compares CONSECUTIVE items (by design — see module
+    docstring), so count(thr) for every candidate is computable in one
+    march: this is the payoff of diverging from the reference's
+    accumulator-relative break test."""
+
+    counts: jnp.ndarray      # i32[B, H, W]
+    prev_rgb: jnp.ndarray    # [3, H, W]
+    prev_empty: jnp.ndarray  # bool[H, W]
+    prev_end: jnp.ndarray    # [H, W]
+
+
+def init_count_multi(bins: int, height: int, width: int) -> MultiCountState:
+    return MultiCountState(jnp.zeros((bins, height, width), jnp.int32),
+                           jnp.zeros((3, height, width), jnp.float32),
+                           jnp.ones((height, width), bool),
+                           jnp.full((height, width), -jnp.inf, jnp.float32))
+
+
+def push_count_multi(state: MultiCountState, tvec: jnp.ndarray,
+                     rgba: jnp.ndarray, t0: jnp.ndarray = None,
+                     t1: jnp.ndarray = None, gap_eps: float = -1.0
+                     ) -> MultiCountState:
+    """`push_count` for B thresholds simultaneously (tvec f32[B]); the
+    break predicate is the SAME `_start_mask`, broadcast over B."""
+    starts, is_empty = _start_mask(state.prev_rgb, state.prev_empty,
+                                   state.prev_end, rgba,
+                                   tvec[:, None, None], t0, gap_eps)
+    prev_end = state.prev_end if t1 is None else \
+        jnp.where(is_empty, state.prev_end, t1)
+    return MultiCountState(state.counts + starts.astype(jnp.int32),
+                           jnp.where(is_empty[None], state.prev_rgb,
+                                     rgba[:3]),
+                           is_empty, prev_end)
+
+
+def pick_threshold(counts: jnp.ndarray, tvec: jnp.ndarray, max_k: int
+                   ) -> jnp.ndarray:
+    """Smallest candidate whose count is <= max_k (counts are non-
+    increasing in threshold). counts i32[B, H, W] -> thr f32[H, W]."""
+    ok = counts <= max_k                                   # [B, H, W]
+    # first True along B (guaranteed at the largest candidate by the
+    # overflow-merge fallback; if even that fails, use the last candidate)
+    idx = jnp.argmax(ok, axis=0)
+    idx = jnp.where(jnp.any(ok, axis=0), idx, len(tvec) - 1)
+    onehot = jax.lax.broadcasted_iota(
+        jnp.int32, (len(tvec), 1, 1), 0) == idx[None]
+    return jnp.sum(jnp.where(onehot, tvec[:, None, None], 0.0), axis=0)

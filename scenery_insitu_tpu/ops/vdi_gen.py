@@ -58,7 +58,21 @@ def generate_vdi(vol: Volume, tf: TransferFunction, cam: Camera,
         rgba = jnp.concatenate([jnp.moveaxis(rgb, -1, 0) * a[None], a[None]])
         return rgba, t - 0.5 * dt, t + 0.5 * dt
 
-    if cfg.adaptive:
+    if cfg.adaptive and cfg.adaptive_mode == "histogram":
+        # ONE counting march evaluating every candidate threshold (the
+        # consecutive-item break metric makes count(thr) separable per
+        # candidate — see ops/supersegments.py)
+        tvec = ss.threshold_candidates(cfg.histogram_bins)
+
+        def body_multi(i, st):
+            rgba, _, _ = sample_at(i)
+            return ss.push_count_multi(st, tvec, rgba)
+
+        counts = jax.lax.fori_loop(
+            0, n, body_multi,
+            ss.init_count_multi(cfg.histogram_bins, height, width)).counts
+        threshold = ss.pick_threshold(counts, tvec, k)
+    elif cfg.adaptive:
         def count_fn(thr):
             def body(i, st):
                 rgba, _, _ = sample_at(i)

@@ -76,9 +76,13 @@ def gather_vdi_compressed(vdi, codec: str = "zstd"
     """Host hop: compress each process's addressable output columns and
     assemble the full (color, depth) on process 0 (returns None elsewhere).
 
-    The wire format is the per-segment variable-length codec; transport is
-    jax's process_allgather on a padded uint8 buffer (the DCN path JAX
-    exposes to hosts)."""
+    Wire format: one dense zstd/zlib blob per process (its contiguous
+    column block: raw color bytes + depth bytes) with per-process byte
+    counts — the variable-length-per-sender idea of the reference's
+    compressed gather, one segment per process rather than
+    io.vdi_io.pack_vdi_segments' per-destination split (here the exchange
+    already happened on-device; only the final gather crosses hosts).
+    Transport is jax's process_allgather on a padded uint8 buffer."""
     import jax
     from jax.experimental import multihost_utils
 
@@ -187,16 +191,16 @@ def _launch(nproc: int, devices_per_proc: int = 2) -> int:
         port = s.getsockname()[1]
     coordinator = f"127.0.0.1:{port}"
 
+    from scenery_insitu_tpu.utils.backend import virtual_mesh_env
+
     procs = []
     for pid in range(nproc):
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
+        base = dict(os.environ)
+        base["XLA_FLAGS"] = " ".join(
+            f for f in base.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f)
+        env = virtual_mesh_env(devices_per_proc, base)
         env["_SITPU_POP_AXON"] = "1"
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if "host_platform_device_count" not in f]
-        env["XLA_FLAGS"] = " ".join(
-            flags + [f"--xla_force_host_platform_device_count="
-                     f"{devices_per_proc}"])
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "scenery_insitu_tpu.parallel.multihost",
              "--coordinator", coordinator, "--processes", str(nproc),
@@ -239,13 +243,7 @@ if __name__ == "__main__":
         sys.exit(_launch(args.launch))
 
     if os.environ.get("_SITPU_POP_AXON") == "1":
-        import jax
+        from scenery_insitu_tpu.utils.backend import pin_cpu_backend
 
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            from jax._src import xla_bridge as _xb
-
-            _xb._backend_factories.pop("axon", None)
-        except Exception:
-            pass
+        pin_cpu_backend()
     _worker(args.coordinator, args.processes, args.process_id)

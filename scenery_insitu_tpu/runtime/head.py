@@ -112,10 +112,12 @@ class HeadNode:
                 payload = {"image": out, "depth": dmin, "frame": frame}
                 for s in self.sinks:
                     s(frame, payload)
-                # drop stragglers that can never complete
-                for old in [f for f in self._pending
-                            if f < frame - self.stale_frames]:
-                    del self._pending[old]
+            # drop stragglers that can never complete — on EVERY message,
+            # not only on completion (a dead rank must not leak the live
+            # ranks' frames forever)
+            for old in [f for f in self._pending
+                        if f < frame - self.stale_frames]:
+                del self._pending[old]
             timeout_ms = 0                                 # drain non-blocking
         return done
 

@@ -1,0 +1,53 @@
+"""Backend bootstrap helpers shared by every benchmark/driver entry point.
+
+This environment registers an external TPU plugin ("axon") in every
+interpreter and pins JAX_PLATFORMS to it; the plugin tunnels to one shared
+chip and HANGS backend lookup when the tunnel is down — and setting
+``JAX_PLATFORMS=cpu`` alone does NOT prevent the hang once the factory is
+registered. Anything that wants a deterministic CPU (virtual-mesh) run
+must both pin the platform and pop the factory, and anything that may run
+after a backend already initialized must re-exec. One implementation here
+instead of a copy per script."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def pin_cpu_backend() -> None:
+    """Pin the current process to the CPU platform and neutralize the axon
+    TPU shim. Must run before any JAX backend initializes (importing jax
+    is fine; touching devices is not)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def virtual_mesh_env(n_devices: int, base: dict = None) -> dict:
+    """Environment for a child process with an n-device virtual CPU mesh
+    (the child must still call pin_cpu_backend() before JAX use)."""
+    env = dict(base if base is not None else os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    return env
+
+
+def reexec_virtual_mesh(n_devices: int, marker: str) -> None:
+    """Replace this process with a copy running on an n-device virtual CPU
+    mesh; ``marker`` is the env flag that breaks the recursion (the child
+    sees it set and proceeds, calling pin_cpu_backend())."""
+    env = virtual_mesh_env(n_devices)
+    env[marker] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Poll the TPU tunnel; when it answers, capture the round's TPU numbers.
+# Results land in benchmarks/results/*.json for inspection/commit.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p benchmarks/results
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
+    echo "TPU back at attempt $i ($(date -u +%H:%M:%S))"
+    python bench.py 2>/dev/null | tail -1 > benchmarks/results/bench_tpu.json
+    cat benchmarks/results/bench_tpu.json
+    SITPU_BENCH_ADAPTIVE_MODE=search python bench.py 2>/dev/null | tail -1 \
+      > benchmarks/results/bench_tpu_search.json
+    cat benchmarks/results/bench_tpu_search.json
+    timeout 1200 python benchmarks/novel_view_bench.py --iters 3 \
+      2>/dev/null | tail -1 > benchmarks/results/novel_view_tpu.json
+    cat benchmarks/results/novel_view_tpu.json
+    timeout 900 python benchmarks/profile_march.py 256 2>/dev/null \
+      > benchmarks/results/profile_march_tpu.txt
+    tail -8 benchmarks/results/profile_march_tpu.txt
+    exit 0
+  fi
+  sleep 180
+done
+echo "TPU never recovered"
+exit 1

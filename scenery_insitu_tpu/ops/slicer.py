@@ -604,11 +604,11 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
 
         def consume_multi(st, rgba, t0, t1):
             for i in range(rgba.shape[0]):
-                st = ss.push_count_multi(st, tvec, rgba[i])
+                st = ss.push_count(st, tvec[:, None, None], rgba[i])
             return st
 
         counts = march(consume_multi,
-                       ss.init_count_multi(cfg.histogram_bins, nj, ni)).counts
+                       ss.init_count_multi(cfg.histogram_bins, nj, ni)).count
         threshold = ss.pick_threshold(counts, tvec, k)
     elif cfg.adaptive:
         def count_fn(thr):

@@ -219,41 +219,19 @@ def threshold_candidates(bins: int, thr_max: float = 2.0,
     return jnp.asarray(np.concatenate([[0.0], t]), jnp.float32)
 
 
-class MultiCountState(NamedTuple):
-    """Counting fold evaluated at ALL candidate thresholds at once. The
+def init_count_multi(bins: int, height: int, width: int) -> CountState:
+    """CountState whose count is [B, H, W] — feed it through the ORDINARY
+    `push_count` with ``threshold=tvec[:, None, None]`` to evaluate every
+    candidate threshold in one march (the `_start_mask` predicate
+    broadcasts, and the prev_* tracking is threshold-independent). The
     break metric compares CONSECUTIVE items (by design — see module
-    docstring), so count(thr) for every candidate is computable in one
-    march: this is the payoff of diverging from the reference's
-    accumulator-relative break test."""
-
-    counts: jnp.ndarray      # i32[B, H, W]
-    prev_rgb: jnp.ndarray    # [3, H, W]
-    prev_empty: jnp.ndarray  # bool[H, W]
-    prev_end: jnp.ndarray    # [H, W]
-
-
-def init_count_multi(bins: int, height: int, width: int) -> MultiCountState:
-    return MultiCountState(jnp.zeros((bins, height, width), jnp.int32),
-                           jnp.zeros((3, height, width), jnp.float32),
-                           jnp.ones((height, width), bool),
-                           jnp.full((height, width), -jnp.inf, jnp.float32))
-
-
-def push_count_multi(state: MultiCountState, tvec: jnp.ndarray,
-                     rgba: jnp.ndarray, t0: jnp.ndarray = None,
-                     t1: jnp.ndarray = None, gap_eps: float = -1.0
-                     ) -> MultiCountState:
-    """`push_count` for B thresholds simultaneously (tvec f32[B]); the
-    break predicate is the SAME `_start_mask`, broadcast over B."""
-    starts, is_empty = _start_mask(state.prev_rgb, state.prev_empty,
-                                   state.prev_end, rgba,
-                                   tvec[:, None, None], t0, gap_eps)
-    prev_end = state.prev_end if t1 is None else \
-        jnp.where(is_empty, state.prev_end, t1)
-    return MultiCountState(state.counts + starts.astype(jnp.int32),
-                           jnp.where(is_empty[None], state.prev_rgb,
-                                     rgba[:3]),
-                           is_empty, prev_end)
+    docstring), which is what makes count(thr) separable per candidate —
+    the payoff of diverging from the reference's accumulator-relative
+    break test."""
+    return CountState(jnp.zeros((bins, height, width), jnp.int32),
+                      jnp.zeros((3, height, width), jnp.float32),
+                      jnp.ones((height, width), bool),
+                      jnp.full((height, width), -jnp.inf, jnp.float32))
 
 
 def pick_threshold(counts: jnp.ndarray, tvec: jnp.ndarray, max_k: int

@@ -122,6 +122,47 @@ def unpack_reference_layout(color_khw4: np.ndarray,
     return VDI(color, jnp.asarray(d))
 
 
+def pack_3layer(vdi: VDI) -> np.ndarray:
+    """Framework VDI -> the older 3-layer packed SINGLE-texture layout:
+    rgba32f ``[3K, H, W, 4]`` where supersegment k occupies layers
+    ``3k`` (color RGBA), ``3k+1`` (start depth in .r) and ``3k+2`` (end
+    depth in .r) — the ``3 * maxSupersegments`` texture of the legacy
+    InVisVolumeRenderer (InVisVolumeRenderer.kt:138-141, consumed by
+    SimpleVDIRenderer.comp). Empty slots zero-filled."""
+    color = np.moveaxis(np.asarray(vdi.color), 1, -1)          # [K, H, W, 4]
+    depth = np.asarray(vdi.depth)                              # [K, 2, H, W]
+    live = np.isfinite(depth[:, 0])
+    k, h, w = live.shape
+    out = np.zeros((3 * k, h, w, 4), np.float32)
+    out[0::3] = np.where(live[..., None], color, 0.0)
+    out[1::3, :, :, 0] = np.where(live, depth[:, 0], 0.0)
+    out[2::3, :, :, 0] = np.where(live, depth[:, 1], 0.0)
+    return out
+
+
+def unpack_3layer(packed: np.ndarray) -> VDI:
+    """Inverse of `pack_3layer` (zero-alpha zero-extent slots -> empty)."""
+    k = packed.shape[0] // 3
+    color = jnp.asarray(np.moveaxis(packed[0::3], -1, 1), jnp.float32)
+    start = np.asarray(packed[1::3, :, :, 0], np.float32)
+    end = np.asarray(packed[2::3, :, :, 0], np.float32)
+    empty = (packed[0::3, :, :, 3] <= 0.0) & (end <= start)
+    d = np.stack([start, end], axis=1)
+    d = np.where(empty[:, None], np.inf, d)
+    return VDI(color, jnp.asarray(d))
+
+
+def render_packed_vdi(packed: np.ndarray,
+                      background=(0.0, 0.0, 0.0, 0.0)) -> jnp.ndarray:
+    """Decode + same-view render of a 3-layer packed VDI (the
+    SimpleVDIRenderer.comp role: alpha-under of the packed supersegments,
+    SimpleVDIRenderer.comp:43-74)."""
+    from scenery_insitu_tpu.core.vdi import render_vdi_same_view
+
+    return render_vdi_same_view(unpack_3layer(packed),
+                                background=background)
+
+
 # ------------------------------------------------------------- validation
 
 

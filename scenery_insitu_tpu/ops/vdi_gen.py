@@ -66,11 +66,11 @@ def generate_vdi(vol: Volume, tf: TransferFunction, cam: Camera,
 
         def body_multi(i, st):
             rgba, _, _ = sample_at(i)
-            return ss.push_count_multi(st, tvec, rgba)
+            return ss.push_count(st, tvec[:, None, None], rgba)
 
         counts = jax.lax.fori_loop(
             0, n, body_multi,
-            ss.init_count_multi(cfg.histogram_bins, height, width)).counts
+            ss.init_count_multi(cfg.histogram_bins, height, width)).count
         threshold = ss.pick_threshold(counts, tvec, k)
     elif cfg.adaptive:
         def count_fn(thr):

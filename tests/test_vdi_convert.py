@@ -102,3 +102,34 @@ def test_validate_vdi_detects_corruption(gathered):
     from scenery_insitu_tpu.core.vdi import VDI
     rep = vc.validate_vdi(VDI(vdi.color, jnp.asarray(bad_depth)))
     assert rep["inverted_extent"] >= 1
+
+
+def test_3layer_packed_roundtrip_and_decode():
+    """The legacy 3-layer single-texture layout (InVisVolumeRenderer.kt:
+    138-141): pack -> unpack is exact for live slots, and the packed decode
+    equals the framework's same-view render."""
+    from scenery_insitu_tpu.core.vdi import render_vdi_same_view
+    from scenery_insitu_tpu.ops.vdi_convert import (pack_3layer,
+                                                    render_packed_vdi,
+                                                    unpack_3layer)
+
+    from scenery_insitu_tpu.core.transfer import TransferFunction
+
+    vol = procedural_volume(16, kind="blobs", seed=2)
+    tf = TransferFunction.ramp(0.1, 0.9, 0.7)
+    cam = Camera.create((0.2, 0.3, 3.0), fov_y_deg=45.0, near=0.5, far=20.0)
+    vdi, _ = generate_vdi(vol, tf, cam, 24, 20,
+                          VDIConfig(max_supersegments=5, adaptive_iters=2),
+                          max_steps=48)
+    packed = pack_3layer(vdi)
+    assert packed.shape == (15, 20, 24, 4)
+    rt = unpack_3layer(packed)
+    live = np.isfinite(np.asarray(vdi.depth[:, 0]))
+    np.testing.assert_allclose(np.asarray(rt.color)[:, 3][live],
+                               np.asarray(vdi.color)[:, 3][live], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rt.depth)[:, 0][live],
+                               np.asarray(vdi.depth)[:, 0][live], atol=1e-6)
+    assert not np.isfinite(np.asarray(rt.depth)[:, 0][~live]).any()
+    img1 = np.asarray(render_vdi_same_view(vdi))
+    img2 = np.asarray(render_packed_vdi(packed))
+    np.testing.assert_allclose(img2, img1, atol=1e-5)

@@ -49,6 +49,22 @@ from scenery_insitu_tpu.ops.slicer import (AxisCamera, AxisSpec,
                                            warp_to_camera)
 
 
+def axis_spec_from_meta(meta: VDIMetadata, chunk: int = 16,
+                        matmul_dtype: str = "bf16") -> AxisSpec:
+    """Reconstruct the static AxisSpec of a slice-march VDI from metadata
+    alone: the virtual camera's forward axis is a volume axis by
+    construction (view row 2 = -forward), and the grid size is the window
+    dims — so a streamed-VDI client needs nothing beyond the wire data."""
+    import numpy as np
+
+    fwd = -np.asarray(meta.view)[2, :3]
+    axis = int(np.argmax(np.abs(fwd)))
+    sign = 1 if fwd[axis] >= 0 else -1
+    return AxisSpec(axis=axis, sign=sign,
+                    ni=int(meta.window_dims[0]), nj=int(meta.window_dims[1]),
+                    chunk=chunk, matmul_dtype=matmul_dtype)
+
+
 def axis_camera_from_meta(meta: VDIMetadata, spec: AxisSpec) -> AxisCamera:
     """Reconstruct the generating virtual axis camera of a slice-march VDI
     from its metadata (for stored/streamed VDIs whose AxisCamera wasn't

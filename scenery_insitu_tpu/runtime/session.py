@@ -268,19 +268,32 @@ class InSituSession:
         self.frame_index += 1
         return out
 
-    def run(self, frames: int, fetch: bool = True) -> dict:
+    def run(self, frames: int, fetch: bool = True,
+            profile_dir: Optional[str] = None) -> dict:
         """Run the loop with one-frame async pipelining; returns last
-        fetched payload."""
-        pending = None
-        payload = {}
-        for i in range(frames):
-            out = self.render_frame()
+        fetched payload.
+
+        ``profile_dir``: capture a device-side profiler trace of the run
+        (open with xprof/tensorboard) — the per-op/per-phase breakdown the
+        host-side timers cannot see because the frame is one fused program
+        (the reference logged host-side phase spans instead,
+        DistributedVolumeRenderer.kt:622-648; see also
+        benchmarks/phase_bench.py for the split-stage numbers)."""
+        import contextlib
+
+        ctx = (jax.profiler.trace(profile_dir) if profile_dir
+               else contextlib.nullcontext())
+        with ctx:
+            pending = None
+            payload = {}
+            for i in range(frames):
+                out = self.render_frame()
+                if pending is not None and fetch:
+                    payload = self._fetch(*pending)
+                pending = (self.frame_index - 1, out)
+                self.timers.frame_done()
             if pending is not None and fetch:
                 payload = self._fetch(*pending)
-            pending = (self.frame_index - 1, out)
-            self.timers.frame_done()
-        if pending is not None and fetch:
-            payload = self._fetch(*pending)
         return payload
 
     def _fetch(self, index: int, out) -> dict:

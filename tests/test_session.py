@@ -113,3 +113,13 @@ def test_bad_env_override_raises(monkeypatch):
 def test_env_override_applies(monkeypatch):
     monkeypatch.setenv("SITPU_RENDER_WIDTH", "512")
     assert FrameworkConfig.load().render.width == 512
+
+
+def test_session_profile_trace(tmp_path):
+    cfg = _cfg()
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    out = sess.run(2, profile_dir=str(tmp_path / "trace"))
+    assert out
+    import glob as _glob
+    assert _glob.glob(str(tmp_path / "trace" / "**" / "*.xplane.pb"),
+                      recursive=True)

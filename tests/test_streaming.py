@@ -253,3 +253,53 @@ def test_head_node_composites_ranks():
         s1.close()
     finally:
         head.close()
+
+
+def test_streamed_mxu_vdi_client_renders_novel_view():
+    """The MXU streamed-VDI client chain end to end: generate on the slice
+    march, ship over ZMQ, reconstruct spec+virtual camera from METADATA
+    ALONE on the client, render a novel view with the gather-free plane
+    sweep (≅ the stored-matrices client of EfficientVDIRaycast.comp)."""
+    from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import procedural_volume
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.ops.vdi_novel import (axis_camera_from_meta,
+                                                  axis_spec_from_meta,
+                                                  render_vdi_mxu)
+
+    vol = procedural_volume(24, kind="blobs", seed=6)
+    tf = for_dataset("procedural")
+    cam0 = Camera.create((0.1, 0.3, 2.9), fov_y_deg=45.0, near=0.3, far=10.0)
+    spec = slicer.make_spec(cam0, vol.data.shape,
+                            SliceMarchConfig(matmul_dtype="f32", scale=1.5))
+    vdi, meta, _ = slicer.generate_vdi_mxu(
+        vol, tf, cam0, spec, VDIConfig(max_supersegments=5,
+                                       adaptive_iters=2))
+
+    pub = VDIPublisher("tcp://127.0.0.1:0")
+    sub = VDISubscriber(pub.endpoint)
+    try:
+        got = None
+        deadline = time.time() + 10.0
+        while time.time() < deadline and got is None:
+            time.sleep(0.05)
+            pub.publish(vdi, meta)
+            got = sub.receive(timeout_ms=200)
+        assert got is not None
+        rvdi, rmeta = got
+
+        rspec = axis_spec_from_meta(rmeta, matmul_dtype="f32")
+        assert (rspec.axis, rspec.sign) == (spec.axis, spec.sign)
+        assert (rspec.ni, rspec.nj) == (spec.ni, spec.nj)
+        axcam = axis_camera_from_meta(rmeta, rspec)
+        cam1 = Camera.create((0.35, 0.45, 2.7), fov_y_deg=45.0,
+                             near=0.3, far=10.0)
+        img = np.asarray(render_vdi_mxu(
+            VDI(jnp.asarray(rvdi.color), jnp.asarray(rvdi.depth)),
+            axcam, rspec, cam1, 64, 48, num_slices=24))
+        assert np.isfinite(img).all()
+        assert img[3].max() > 0.1
+    finally:
+        pub.close()
+        sub.close()

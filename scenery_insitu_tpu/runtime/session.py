@@ -41,6 +41,28 @@ from scenery_insitu_tpu.sim import vortex as vx
 Sink = Callable[[int, dict], None]
 
 
+def drain_steering(sess) -> None:
+    """Apply all pending steering messages to ``sess`` (camera updates in
+    place, other kinds to the on_steer callbacks). Shared by InSituSession
+    and SceneSession so the steering protocol has ONE consumer."""
+    if sess.steering is None:
+        return
+    from scenery_insitu_tpu.runtime.streaming import apply_steering
+    with sess.timers.phase("steer"):
+        for msg in sess.steering.drain():
+            sess.camera, other = apply_steering(sess.camera, msg)
+            for kind_msg in other.values():
+                for cb in sess.on_steer:
+                    cb(kind_msg)
+
+
+def advance_camera_and_index(sess) -> None:
+    """Benchmark-orbit the camera (if enabled) and bump the frame index."""
+    if sess.orbit_rate:
+        sess.camera = orbit(sess.camera, jnp.float32(sess.orbit_rate))
+    sess.frame_index += 1
+
+
 class VolumeSimAdapter:
     """Uniform facade over the built-in volume sims (kind -> state/advance/
     field)."""
@@ -228,14 +250,7 @@ class InSituSession:
 
     def render_frame(self):
         """Advance the sim and dispatch one render step (device arrays)."""
-        if self.steering is not None:
-            from scenery_insitu_tpu.runtime.streaming import apply_steering
-            with self.timers.phase("steer"):
-                for msg in self.steering.drain():
-                    self.camera, other = apply_steering(self.camera, msg)
-                    for kind_msg in other.values():
-                        for cb in self.on_steer:
-                            cb(kind_msg)
+        drain_steering(self)
         with self.timers.phase("sim"):
             self.sim.advance(self.cfg.sim.steps_per_frame)
         with self.timers.phase("dispatch"):
@@ -263,9 +278,7 @@ class InSituSession:
         # metadata snapshot BEFORE the camera advances (fetch is pipelined
         # one frame behind, so it must not see the next frame's pose)
         self._pending_meta[self.frame_index] = meta
-        if self.orbit_rate:
-            self.camera = orbit(self.camera, jnp.float32(self.orbit_rate))
-        self.frame_index += 1
+        advance_camera_and_index(self)
         return out
 
     def run(self, frames: int, fetch: bool = True,

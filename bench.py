@@ -13,18 +13,19 @@ sized by the volume × scale, NOT SITPU_BENCH_WIDTH/HEIGHT — those apply
 only to the legacy gather engine).
 
 Robustness (round-1 lesson — BENCH_r01 died in TPU backend init): the
-parent process NEVER touches a JAX backend. It probes/runs each platform
-candidate in a subprocess with a hard timeout (this environment's ``axon``
-TPU shim can HANG backend access when the tunnel is down), retries TPU
-with backoff, falls back to a pinned 1-device CPU run, and on total
-failure still prints a parseable JSON error line and exits 0.
+parent process NEVER touches a JAX backend. Each TPU attempt is gated by
+a cheap subprocess probe with a hard timeout (this environment's ``axon``
+TPU shim can HANG backend access when the tunnel is down), the platform
+list (default tpu,tpu,cpu = one TPU retry with backoff) runs each
+candidate in its own subprocess, the CPU fallback is pinned, and on
+total failure one parseable JSON error line is still printed (exit 0).
 
 Knobs via env (defaults tuned for one v5e chip):
   SITPU_BENCH_GRID=256  SITPU_BENCH_WIDTH=1280 SITPU_BENCH_HEIGHT=720
   SITPU_BENCH_STEPS=256 SITPU_BENCH_K=16 SITPU_BENCH_FRAMES=5
   SITPU_BENCH_SIM_STEPS=10 SITPU_BENCH_ADAPTIVE_ITERS=2
   SITPU_BENCH_ENGINE=mxu|gather
-  SITPU_BENCH_PLATFORMS=tpu,cpu  SITPU_BENCH_CHILD_TIMEOUT=900
+  SITPU_BENCH_PLATFORMS=tpu,cpu  SITPU_BENCH_CHILD_TIMEOUT=600
 Baseline: the project north star of 30 FPS (BASELINE.json) — vs_baseline is
 measured_fps / 30.
 """
@@ -187,9 +188,33 @@ def _child_env(platform: str) -> dict:
     return env
 
 
+def _probe_tpu() -> bool:
+    """Can the TPU backend actually answer? A dead tunnel HANGS instead of
+    erroring, so this must be a subprocess with a hard timeout — and must
+    run BEFORE committing the full benchmark to the TPU attempt. Raise
+    SITPU_BENCH_PROBE_TIMEOUT on clusters with slow cold backend init (a
+    probe false-negative demotes the headline number to the CPU fallback;
+    the second platforms entry retries the probe)."""
+    timeout_s = _env_int("SITPU_BENCH_PROBE_TIMEOUT", 150)
+    code = ("import jax\n"
+            "assert jax.devices()[0].platform == 'tpu'\n"
+            "import jax.numpy as jnp\n"
+            "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))\n")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              env=dict(os.environ), timeout=timeout_s,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _run_child(platform: str, timeout_s: int):
     """Run the benchmark on one platform candidate in a subprocess; return
     the parsed result dict or an error string."""
+    if platform == "tpu" and not _probe_tpu():
+        return None, "tpu: probe failed (tunnel dead or hung)"
     print(f"[bench] trying platform={platform} (timeout {timeout_s}s)",
           file=sys.stderr, flush=True)
     try:
@@ -216,7 +241,10 @@ def _run_child(platform: str, timeout_s: int):
 
 def _orchestrate():
     grid = _env_int("SITPU_BENCH_GRID", 256)
-    timeout_s = _env_int("SITPU_BENCH_CHILD_TIMEOUT", 900)
+    # worst case must stay well inside the driver's recording window: a
+    # dead tunnel costs one cheap probe per TPU attempt (not the full
+    # child timeout) + the CPU fallback
+    timeout_s = _env_int("SITPU_BENCH_CHILD_TIMEOUT", 600)
     platforms = os.environ.get("SITPU_BENCH_PLATFORMS", "tpu,tpu,cpu")
     errors = []
     for i, platform in enumerate(p.strip() for p in platforms.split(",")):

@@ -50,7 +50,7 @@ class SceneSession:
         from scenery_insitu_tpu.ops import slicer as _slicer
         self._slicer = _slicer
         self.engine = _slicer.resolve_engine(self.cfg.slicer.engine)
-        self._specs = {}           # (regime, grid signature) -> AxisSpec
+        self._steps = {}   # (regime, grid-set signature) -> jitted step
 
     # ------------------------------------------------- operator boundary
     def update_data(self, partner: int, grids, origins, spacing,
@@ -73,29 +73,21 @@ class SceneSession:
             advance_camera_and_index, drain_steering)
 
         drain_steering(self)
-        r = self.cfg.render
         with self.timers.phase("dispatch"):
-            if self.cfg.runtime.generate_vdis and self.engine == "mxu":
-                spec = self._spec()
-                vdi, meta = self.scene.generate_vdi_mxu(
-                    self.tf, self.camera, spec, self.cfg.vdi,
-                    self.cfg.composite)
-            elif self.cfg.runtime.generate_vdis:
-                vdi, meta = self.scene.generate_vdi(
-                    self.tf, self.camera, r.width, r.height, self.cfg.vdi,
-                    self.cfg.composite, max_steps=r.max_steps)
-            else:
-                img = self.scene.render(self.tf, self.camera,
-                                        r.width, r.height, r)
-                vdi, meta = None, None
+            step = self._step()
+            gs = self.scene.grids
+            out = step(tuple(g.volume.data for g in gs),
+                       tuple(g.volume.origin for g in gs),
+                       tuple(g.volume.spacing for g in gs), self.camera)
         with self.timers.phase("fetch"):
-            if vdi is not None:
+            if self.cfg.runtime.generate_vdis:
+                vdi, meta = out
                 payload = {"vdi_color": np.asarray(vdi.color),
                            "vdi_depth": np.asarray(vdi.depth),
                            "meta": meta._replace(
                                index=np.int32(self.frame_index))}
             else:
-                payload = {"image": np.asarray(img)}
+                payload = {"image": np.asarray(out)}
             payload["frame"] = self.frame_index
         with self.timers.phase("sinks"):
             for s in self.sinks:
@@ -104,19 +96,48 @@ class SceneSession:
         self.timers.frame_done()
         return payload
 
-    def _spec(self):
-        """AxisSpec for the current camera regime + scene shape (cached;
-        sized from the scene's global voxel extent)."""
+    def _step(self):
+        """Jitted whole-scene step for the current camera regime and the
+        current grid-set SIGNATURE (shapes + ghosts are static; data,
+        origins, spacings and the camera are traced) — one compilation per
+        signature, like InSituSession._mxu_step. A driver that repartitions
+        (new shapes) triggers exactly one recompile."""
         regime = self._slicer.choose_axis(self.camera)
-        lo, hi = self.scene.global_bounds()
-        sp = self.scene.grids[0].volume.spacing
-        dims = tuple(int(round(float(d)))
-                     for d in np.asarray((hi - lo) / sp))   # (x, y, z)
-        key = (regime, dims)
-        spec = self._specs.get(key)
-        if spec is None:
-            shape_dhw = (dims[2], dims[1], dims[0])
-            spec = self._slicer.make_spec(self.camera, shape_dhw,
-                                          self.cfg.slicer, axis_sign=regime)
-            self._specs[key] = spec
-        return spec
+        gs = self.scene.grids
+        sig = tuple((tuple(g.volume.data.shape), g.ghost_lo, g.ghost_hi)
+                    for g in gs)
+        key = (regime, sig, self.engine, self.cfg.runtime.generate_vdis)
+        step = self._steps.get(key)
+        if step is not None:
+            return step
+
+        ghosts = [(g.ghost_lo, g.ghost_hi) for g in gs]
+        r = self.cfg.render
+        cfg = self.cfg
+        tf = self.tf
+        spec = None
+        if cfg.runtime.generate_vdis and self.engine == "mxu":
+            lo, hi = self.scene.global_bounds()
+            sp = gs[0].volume.spacing
+            dims = tuple(int(round(float(d)))
+                         for d in np.asarray((hi - lo) / sp))   # (x, y, z)
+            spec = self._slicer.make_spec(self.camera,
+                                          (dims[2], dims[1], dims[0]),
+                                          cfg.slicer, axis_sign=regime)
+
+        def fn(datas, origins, spacings, cam):
+            sc = MultiGridScene()
+            for i, (d, o, s) in enumerate(zip(datas, origins, spacings)):
+                sc.set_grid(0, i, d, o, s, *ghosts[i])
+            if cfg.runtime.generate_vdis and self.engine == "mxu":
+                return sc.generate_vdi_mxu(tf, cam, spec, cfg.vdi,
+                                           cfg.composite)
+            if cfg.runtime.generate_vdis:
+                return sc.generate_vdi(tf, cam, r.width, r.height,
+                                       cfg.vdi, cfg.composite,
+                                       max_steps=r.max_steps)
+            return sc.render(tf, cam, r.width, r.height, r)
+
+        step = jax.jit(fn)
+        self._steps[key] = step
+        return step

@@ -94,8 +94,11 @@ def main():
     sim_steps = _env_int("SITPU_BENCH_SIM_STEPS", 10)
     ad_iters = _env_int("SITPU_BENCH_ADAPTIVE_ITERS", 2)
     # histogram: ONE counting march for all candidate thresholds (higher
-    # segment fidelity than a 2-iter search AND fewer marches)
-    ad_mode = os.environ.get("SITPU_BENCH_ADAPTIVE_MODE", "histogram")
+    # segment fidelity than a 2-iter search AND fewer marches).
+    # temporal: NO counting march in steady state — threshold carried
+    # across frames (seeded by one histogram march at warmup); mxu-only,
+    # so the gather engine downgrades to histogram.
+    ad_mode = os.environ.get("SITPU_BENCH_ADAPTIVE_MODE", "temporal")
 
     dev = jax.devices()[0]
     platform = dev.platform
@@ -106,6 +109,10 @@ def main():
     from scenery_insitu_tpu.ops import slicer
     engine = os.environ.get("SITPU_BENCH_ENGINE", "mxu")
     engine = slicer.resolve_engine(engine)
+    if ad_mode == "temporal" and engine != "mxu":
+        print("[bench] temporal mode is mxu-only; using histogram",
+              file=sys.stderr, flush=True)
+        ad_mode = "histogram"
 
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
     frame_step = grayscott_vdi_frame_step(
@@ -120,16 +127,27 @@ def main():
     # the mxu step is compiled for the base camera's march regime (axis z
     # here); oscillate the orbit within ±0.35 rad so every benched frame
     # stays inside that regime no matter how many frames are requested
-    def frame(u, v, yaw):
-        return frame_step(u, v, orbit(base, yaw).eye)
+    temporal = ad_mode == "temporal" and engine == "mxu"
+    if temporal:
+        def frame(u, v, yaw, thr):
+            return frame_step(u, v, orbit(base, yaw).eye, thr)
+    else:
+        def frame(u, v, yaw):
+            return frame_step(u, v, orbit(base, yaw).eye)
 
     frame = jax.jit(frame)
     st = gs.GrayScott.init((grid, grid, grid))
     u, v = st.u, st.v
 
-    # warmup / compile
+    # warmup / compile (temporal: seed the threshold state + 2 settle
+    # frames so the measured loop is the steady-state one-march regime)
     t_c = time.perf_counter()
-    c, d, u, v = frame(u, v, jnp.float32(0.0))
+    if temporal:
+        thr = jax.jit(frame_step.init_threshold)(u, v, base.eye)
+        for _ in range(3):
+            c, d, u, v, thr = frame(u, v, jnp.float32(0.0), thr)
+    else:
+        c, d, u, v = frame(u, v, jnp.float32(0.0))
     jax.block_until_ready(c)
     compile_s = time.perf_counter() - t_c
     print(f"[bench] warmup+compile {compile_s:.1f}s", file=sys.stderr,
@@ -139,7 +157,10 @@ def main():
     t0 = time.perf_counter()
     for i in range(frames):
         yaw = 0.35 * math.sin(0.7 * (i + 1))
-        c, d, u, v = frame(u, v, jnp.float32(yaw))
+        if temporal:
+            c, d, u, v, thr = frame(u, v, jnp.float32(yaw), thr)
+        else:
+            c, d, u, v = frame(u, v, jnp.float32(yaw))
     jax.block_until_ready(c)
     dt = (time.perf_counter() - t0) / frames
 
@@ -153,7 +174,8 @@ def main():
         spec = slicer.make_spec(base, (grid, grid, grid), SliceMarchConfig())
         render_cfg = {"image": [spec.ni, spec.nj], "steps": grid}
         res_tag = f"{spec.ni}x{spec.nj}"
-        marches = 2 if ad_mode == "histogram" else ad_iters + 1
+        marches = (1 if temporal else
+                   2 if ad_mode == "histogram" else ad_iters + 1)
         if peak:
             mfu = round(_slice_march_flops(spec, grid, marches) * fps / peak,
                         5)

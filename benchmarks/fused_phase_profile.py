@@ -1,0 +1,103 @@
+"""Incremental profile of the FUSED flagship frame (the exact bench.py
+path): times cumulative prefixes of the pipeline inside one jit each, so
+phase costs reflect what XLA actually schedules (fusion included), not
+isolated-kernel estimates. Usage: python benchmarks/fused_phase_profile.py
+[grid]."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, args, n=5, label=""):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n * 1000
+    print(f"{label:46s} {dt:9.2f} ms")
+    return dt
+
+
+def main():
+    from scenery_insitu_tpu.config import (CompositeConfig, SliceMarchConfig,
+                                           VDIConfig)
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import Volume
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.ops import supersegments as ss
+    from scenery_insitu_tpu.ops.composite import composite_vdis
+    from scenery_insitu_tpu.sim import grayscott as gs
+
+    grid = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    k = 16
+    sim_steps = 10
+    tf = for_dataset("gray_scott")
+    cam = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
+    spec = slicer.make_spec(cam, (grid, grid, grid), SliceMarchConfig())
+    vdi_cfg = VDIConfig(max_supersegments=k, adaptive_iters=2,
+                        adaptive_mode="histogram")
+    comp_cfg = CompositeConfig(max_output_supersegments=k, adaptive_iters=2)
+    print(f"grid={grid} ni={spec.ni} nj={spec.nj} chunk={spec.chunk} "
+          f"dtype={spec.matmul_dtype} backend={jax.default_backend()}")
+
+    st = gs.GrayScott.init((grid, grid, grid))
+    st = gs.multi_step(st, 30)
+    jax.block_until_ready(st.u)
+    params = st.params
+    args = (st.u, st.v)
+
+    def sim_only(u, v):
+        s = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
+        return s.u, s.v
+
+    timeit(jax.jit(sim_only), args, label=f"sim x{sim_steps} (fast path)")
+
+    def sim_count(u, v):
+        s = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
+        vol = Volume.centered(s.field, extent=2.0)
+        axcam = slicer.make_axis_camera(vol, cam, spec)
+        occ = slicer.chunk_occupancy(vol, tf, spec)
+        tvec = ss.threshold_candidates(vdi_cfg.histogram_bins)
+
+        def consume(cst, rgba, t0, t1):
+            for i in range(rgba.shape[0]):
+                cst = ss.push_count(cst, tvec[:, None, None], rgba[i])
+            return cst
+
+        counts = slicer.slice_march(
+            vol, tf, axcam, spec, consume,
+            ss.init_count_multi(vdi_cfg.histogram_bins, spec.nj, spec.ni),
+            occupancy=occ).count
+        return counts
+
+    timeit(jax.jit(sim_count), args, label="+ histogram counting march")
+
+    def sim_gen(u, v):
+        s = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
+        vol = Volume.centered(s.field, extent=2.0)
+        vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec, vdi_cfg)
+        return vdi.color
+
+    timeit(jax.jit(sim_gen), args, label="+ write march (full generate)")
+
+    def full(u, v):
+        s = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
+        vol = Volume.centered(s.field, extent=2.0)
+        vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec, vdi_cfg)
+        out = composite_vdis(vdi.color[None], vdi.depth[None], comp_cfg)
+        return out.color
+
+    timeit(jax.jit(full), args, label="+ composite (full frame)")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,14 +50,27 @@ class VDIConfig:
     # "histogram": ONE counting march evaluating histogram_bins candidate
     # thresholds simultaneously (possible because the break metric compares
     # consecutive items — see ops/supersegments.py) then pick per pixel.
+    # "temporal": NO counting march — the per-pixel threshold is carried
+    # across frames and nudged by a feedback controller from the true
+    # segment count observed during the write march itself (see
+    # slicer.generate_vdi_mxu_temporal). One march per frame; exploits the
+    # frame-to-frame coherence of an in-situ loop.
     adaptive_mode: str = "search"
     histogram_bins: int = 16
+    # temporal mode: per-frame outward decay of the controller's bisection
+    # bracket (1.0 = frozen bracket, never re-adapts; smaller = tracks
+    # faster-changing scenes at the cost of steady-state wobble), and the
+    # clamp range the controller moves inside (thr_max matches the
+    # histogram candidate ceiling, ss.threshold_candidates).
+    temporal_track: float = 0.9
+    thr_min: float = 1e-3
+    thr_max: float = 2.0
 
     def __post_init__(self):
-        if self.adaptive_mode not in ("search", "histogram"):
+        if self.adaptive_mode not in ("search", "histogram", "temporal"):
             raise ValueError(
-                f"adaptive_mode must be 'search' or 'histogram', "
-                f"got {self.adaptive_mode!r}")
+                f"adaptive_mode must be 'search', 'histogram' or "
+                f"'temporal', got {self.adaptive_mode!r}")
     # Occupancy grid (≅ OctreeCells r32ui [W/8, H/8, K]): cell size in pixels.
     occupancy_cell: int = 8
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -146,13 +147,28 @@ class MultiGridScene:
         from scenery_insitu_tpu.ops.composite import composite_vdis
 
         lo, hi = self.global_bounds()
-        a, ua, va = spec.axis, spec.u_axis, spec.v_axis
-        data_dim = {0: 2, 1: 1, 2: 0}   # xyz axis -> data dim of [z, y, x]
-
         vdis = []
         meta = None
+        for vol, ub, vb in self._march_grids(spec, lo, hi):
+            vdi, meta, _ = slicer.generate_vdi_mxu(
+                vol, tf, cam, spec, cfg, box_min=lo, box_max=hi,
+                u_bounds=ub, v_bounds=vb)
+            vdis.append(vdi)
+        meta = self._scene_meta(meta, lo, hi)
+        out = composite_vdis(jnp.stack([v.color for v in vdis]),
+                             jnp.stack([v.depth for v in vdis]), comp_cfg)
+        return out, meta
+
+    def _march_grids(self, spec, lo, hi):
+        """Per-grid (volume, u_bounds, v_bounds) for a whole-scene slice
+        march: ghost slices along the march axis dropped statically so no
+        slab is double-counted; in-plane ghosts stay for seam-exact
+        bilinear with half-open ownership bounds (the same scheme as the
+        distributed pipeline's `_mxu_rank_generate`)."""
+        a, ua, va = spec.axis, spec.u_axis, spec.v_axis
+        data_dim = {0: 2, 1: 1, 2: 0}   # xyz axis -> data dim of [z, y, x]
+        out = []
         for g in self.grids:
-            # drop ghost slices along the march axis (static slicing)
             dd = data_dim[a]
             n_a = g.volume.data.shape[dd]
             sl = [slice(None)] * 3
@@ -164,22 +180,60 @@ class MultiGridScene:
 
             # half-open ownership on the in-plane axes; at the global max
             # face re-admit pos == hi (capped by the volume-extent mask)
-            def bounds(ax):
+            def bounds(ax, g=g):
                 blo = g.interior_min[ax]
                 bhi = g.interior_max[ax]
                 slack = jnp.where(bhi >= hi[ax] - 1e-6,
                                   g.volume.spacing[ax], 0.0)
                 return (blo, bhi + slack)
 
-            vdi, meta, _ = slicer.generate_vdi_mxu(
-                vol, tf, cam, spec, cfg, box_min=lo, box_max=hi,
-                u_bounds=bounds(ua), v_bounds=bounds(va))
-            vdis.append(vdi)
+            out.append((vol, bounds(ua), bounds(va)))
+        return out
+
+    def _scene_meta(self, meta, lo, hi):
         dims = (hi - lo) / self.grids[0].volume.spacing
-        meta = meta._replace(volume_dims=dims)
+        return meta._replace(volume_dims=dims)
+
+    def initial_thresholds(self, tf: TransferFunction, cam: Camera, spec,
+                           cfg: Optional[VDIConfig] = None):
+        """Temporal-threshold seed with [G, nj, ni] maps, one per grid
+        (each grid's sub-VDI runs its own supersegment machine —
+        counterpart of slicer.initial_threshold for the whole scene)."""
+        from scenery_insitu_tpu.ops import slicer
+
+        lo, hi = self.global_bounds()
+        states = [slicer.initial_threshold(vol, tf, cam, spec, cfg,
+                                           box_min=lo, box_max=hi,
+                                           u_bounds=ub, v_bounds=vb)
+                  for vol, ub, vb in self._march_grids(spec, lo, hi)]
+        return jax.tree_util.tree_map(lambda *a: jnp.stack(a), *states)
+
+    def generate_vdi_mxu_temporal(self, tf: TransferFunction, cam: Camera,
+                                  spec, thresholds,
+                                  cfg: Optional[VDIConfig] = None,
+                                  comp_cfg: Optional[CompositeConfig] = None
+                                  ) -> Tuple[VDI, VDIMetadata, object]:
+        """Whole-scene VDI with carried per-grid threshold state (one
+        march per grid per frame; see slicer.generate_vdi_mxu_temporal).
+        Returns (vdi, meta, next_thresholds)."""
+        from scenery_insitu_tpu.ops import slicer
+        from scenery_insitu_tpu.ops.composite import composite_vdis
+
+        lo, hi = self.global_bounds()
+        vdis, thrs = [], []
+        meta = None
+        for i, (vol, ub, vb) in enumerate(self._march_grids(spec, lo, hi)):
+            state_i = jax.tree_util.tree_map(lambda x: x[i], thresholds)
+            vdi, meta, _, thr = slicer.generate_vdi_mxu_temporal(
+                vol, tf, cam, spec, state_i, cfg, box_min=lo,
+                box_max=hi, u_bounds=ub, v_bounds=vb)
+            vdis.append(vdi)
+            thrs.append(thr)
+        meta = self._scene_meta(meta, lo, hi)
         out = composite_vdis(jnp.stack([v.color for v in vdis]),
                              jnp.stack([v.depth for v in vdis]), comp_cfg)
-        return out, meta
+        return (out, meta,
+                jax.tree_util.tree_map(lambda *a: jnp.stack(a), *thrs))
 
     def render(self, tf: TransferFunction, cam: Camera,
                width: int, height: int,

@@ -40,7 +40,15 @@ def grayscott_vdi_frame_step(width: int, height: int,
     returned step is called with must stay inside that regime (within 45°
     of the axis); build one step per regime otherwise). The VDI then lives
     on the virtual axis camera's grid instead of (width, height). "auto"
-    resolves to mxu on TPU, gather elsewhere."""
+    resolves to mxu on TPU, gather elsewhere.
+
+    With ``vdi_cfg.adaptive_mode == "temporal"`` (mxu only) the step
+    signature gains carried threshold state:
+    ``fn(u, v, eye, thr) -> (color, depth, u, v, thr')`` — seed thr with
+    the returned function's ``init_threshold(u, v, eye)`` attribute (one
+    jittable histogram counting march), then thread it through the frame
+    loop (one march per frame instead of two; see
+    slicer.generate_vdi_mxu_temporal)."""
     from scenery_insitu_tpu.ops import slicer
 
     tf = tf or for_dataset("gray_scott")
@@ -63,18 +71,38 @@ def grayscott_vdi_frame_step(width: int, height: int,
             Camera.create((0.0, 0.6, 3.0), fov_y_deg=fov_y_deg),
             tuple(grid_shape), slicer_cfg, axis_sign=axis_sign)
 
-    def frame_step(u, v, eye):
+    temporal = vdi_cfg.adaptive and vdi_cfg.adaptive_mode == "temporal"
+    if temporal and engine != "mxu":
+        raise ValueError("adaptive_mode='temporal' needs engine='mxu'")
+
+    def frame_step(u, v, eye, thr=None):
         state = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
         vol = Volume.centered(state.field, extent=2.0)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
-        if engine == "mxu":
+        if temporal:
+            vdi, _, _, thr = slicer.generate_vdi_mxu_temporal(
+                vol, tf, cam, spec, thr, vdi_cfg)
+        elif engine == "mxu":
             vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec, vdi_cfg)
         else:
             vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
                                   max_steps=max_steps)
         out = composite_vdis(vdi.color[None], vdi.depth[None], comp_cfg)
+        if temporal:
+            return out.color, out.depth, state.u, state.v, thr
         return out.color, out.depth, state.u, state.v
 
+    if temporal:
+        def init_threshold(u, v, eye):
+            """Jittable seed for the carried threshold state (one
+            histogram counting march on the current sim state)."""
+            vol = Volume.centered(gs.GrayScott(u, v, params).field,
+                                  extent=2.0)
+            cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5,
+                                far=20.0)
+            return slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg)
+
+        frame_step.init_threshold = init_threshold
     return frame_step
 
 

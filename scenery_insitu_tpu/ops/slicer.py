@@ -598,18 +598,13 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
         occupancy=occ)
 
+    if cfg.adaptive and cfg.adaptive_mode == "temporal":
+        raise ValueError(
+            "adaptive_mode='temporal' carries per-frame threshold state — "
+            "call generate_vdi_mxu_temporal(..., threshold=...) instead "
+            "(seed the state with initial_threshold())")
     if cfg.adaptive and cfg.adaptive_mode == "histogram":
-        # one counting march for ALL candidate thresholds at once
-        tvec = ss.threshold_candidates(cfg.histogram_bins)
-
-        def consume_multi(st, rgba, t0, t1):
-            for i in range(rgba.shape[0]):
-                st = ss.push_count(st, tvec[:, None, None], rgba[i])
-            return st
-
-        counts = march(consume_multi,
-                       ss.init_count_multi(cfg.histogram_bins, nj, ni)).count
-        threshold = ss.pick_threshold(counts, tvec, k)
+        threshold = _histogram_threshold(march, cfg, k, nj, ni)
     elif cfg.adaptive:
         def count_fn(thr):
             def consume(st, rgba, t0, t1):
@@ -630,14 +625,106 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     state = march(consume, ss.init_state(k, nj, ni))
     color, depth = ss.finalize(state)
 
+    meta = _vdi_meta(vol, axcam, ni, nj, frame_index)
+    return VDI(color, depth), meta, axcam
+
+
+def _vdi_meta(vol: Volume, axcam: AxisCamera, ni: int, nj: int,
+              frame_index: int) -> VDIMetadata:
     dims = jnp.asarray(vol.dims_xyz, jnp.float32)
     # model = voxel->world affine (diag spacing + origin): consumers that
     # only get metadata (axis_camera_from_meta) read the per-axis pitch
     # from here — nw alone is min(spacing), wrong for anisotropic volumes
     model = jnp.diag(jnp.concatenate([vol.spacing, jnp.ones(1)]))
     model = model.at[:3, 3].set(vol.origin)
-    meta = VDIMetadata.create(projection=axcam.proj, view=axcam.view,
+    return VDIMetadata.create(projection=axcam.proj, view=axcam.view,
                               model=model, volume_dims=dims,
                               window_dims=(ni, nj),
                               nw=nominal_step(vol), index=frame_index)
-    return VDI(color, depth), meta, axcam
+
+
+def _histogram_threshold(march, cfg: VDIConfig, k: int, nj: int, ni: int
+                         ) -> jnp.ndarray:
+    """One counting march for ALL candidate thresholds at once."""
+    tvec = ss.threshold_candidates(cfg.histogram_bins)
+
+    def consume_multi(st, rgba, t0, t1):
+        for i in range(rgba.shape[0]):
+            st = ss.push_count(st, tvec[:, None, None], rgba[i])
+        return st
+
+    counts = march(consume_multi,
+                   ss.init_count_multi(cfg.histogram_bins, nj, ni)).count
+    return ss.pick_threshold(counts, tvec, k)
+
+
+def initial_threshold(vol: Volume, tf: TransferFunction, cam: Camera,
+                      spec: AxisSpec, cfg: Optional[VDIConfig] = None,
+                      box_min: Optional[jnp.ndarray] = None,
+                      box_max: Optional[jnp.ndarray] = None,
+                      u_bounds=None, v_bounds=None) -> ss.ThresholdState:
+    """Seed state for the temporal threshold controller ([nj, ni] maps):
+    one histogram counting march on the current scene (the same pass
+    adaptive_mode="histogram" runs every frame — temporal mode runs it
+    once at session start, then `generate_vdi_mxu_temporal` keeps the map
+    in band for one-march frames)."""
+    cfg = cfg or VDIConfig()
+    axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
+    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
+    march = lambda consume, carry0: slice_march(
+        vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
+        occupancy=occ)
+    thr = _histogram_threshold(march, cfg, cfg.max_supersegments,
+                               spec.nj, spec.ni)
+    return ss.init_threshold_state(thr, cfg.thr_min, cfg.thr_max)
+
+
+def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
+                              cam: Camera, spec: AxisSpec,
+                              threshold: ss.ThresholdState,
+                              cfg: Optional[VDIConfig] = None,
+                              frame_index: int = 0,
+                              box_min: Optional[jnp.ndarray] = None,
+                              box_max: Optional[jnp.ndarray] = None,
+                              u_bounds=None, v_bounds=None,
+                              ) -> Tuple[VDI, VDIMetadata, AxisCamera,
+                                         ss.ThresholdState]:
+    """VDI generation with ONE march per frame (adaptive_mode="temporal").
+
+    ``threshold`` is carried controller state (seed with
+    `initial_threshold`). The write march folds the supersegment writer
+    and the O(1) start counter side by side — same slices, same threshold —
+    so the true per-pixel segment count comes out of the march that wrote
+    the VDI, and `ss.update_threshold` bisects the map toward the target
+    band for the next frame. Returns (vdi, meta, axcam, next_threshold).
+
+    Compared to "histogram" mode this halves the march count per frame at
+    the cost of one-frame adaptation lag: a pixel whose content changed
+    drastically this frame is written with last frame's threshold (its
+    overflow merges into the last slot — the same graceful degradation
+    every mode shares) and corrected over the following frames.
+    """
+    cfg = cfg or VDIConfig()
+    k = cfg.max_supersegments
+    nj, ni = spec.nj, spec.ni
+    thr = threshold.thr
+    axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
+    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
+
+    def consume(carry, rgba, t0, t1):
+        st, cst = carry
+        for i in range(rgba.shape[0]):
+            st = ss.push(st, k, thr, rgba[i], t0[i], t1[i])
+            cst = ss.push_count(cst, thr, rgba[i])
+        return st, cst
+
+    state, cstate = slice_march(
+        vol, tf, axcam, spec, consume,
+        (ss.init_state(k, nj, ni), ss.init_count(nj, ni)),
+        u_bounds, v_bounds, occupancy=occ)
+    color, depth = ss.finalize(state)
+    next_thr = ss.update_threshold(threshold, cstate.count, k,
+                                   cfg.adaptive_delta, cfg.thr_min,
+                                   cfg.thr_max, cfg.temporal_track)
+    meta = _vdi_meta(vol, axcam, ni, nj, frame_index)
+    return VDI(color, depth), meta, axcam, next_thr

@@ -234,6 +234,69 @@ def init_count_multi(bins: int, height: int, width: int) -> CountState:
                       jnp.full((height, width), -jnp.inf, jnp.float32))
 
 
+class ThresholdState(NamedTuple):
+    """Carried state of the temporal threshold controller
+    (adaptive_mode="temporal"): the active per-pixel threshold plus the
+    bisection bracket [lo, hi] — lo is known (or decayed toward) to
+    overflow, hi known to fit. All [H, W]."""
+
+    thr: jnp.ndarray
+    lo: jnp.ndarray
+    hi: jnp.ndarray
+
+
+def init_threshold_state(thr: jnp.ndarray, thr_min: float = 1e-3,
+                         thr_max: float = 2.0) -> ThresholdState:
+    thr = jnp.clip(thr, thr_min, thr_max)
+    return ThresholdState(thr, jnp.full_like(thr, thr_min),
+                          jnp.full_like(thr, thr_max))
+
+
+def update_threshold(state: ThresholdState, count: jnp.ndarray, max_k: int,
+                     delta: float = 0.15, thr_min: float = 1e-3,
+                     thr_max: float = 2.0, track: float = 0.9
+                     ) -> ThresholdState:
+    """Temporal-coherence threshold controller: ONE bisection step per
+    frame toward the reference's target band ``[K*(1-delta), K]``
+    (VDIGenerator.comp:380-529 re-marches a full per-pixel binary search
+    every frame; an in-situ loop can amortize that search across frames,
+    because neither the simulation state nor the camera moves much between
+    consecutive frames).
+
+    ``count`` is the TRUE (uncapped) per-pixel segment count observed
+    while writing with ``state.thr``. Over the cap → the threshold
+    becomes the bracket's lower bound and bisects up; under the band → it
+    becomes the upper bound and bisects down; in band → hold. A plain
+    multiplicative controller oscillates forever on pixels whose count
+    jumps across the band (lower → overflow → raise → under → lower …);
+    the persistent bracket makes those pixels converge onto the knife
+    edge. Asymmetry, on purpose: overflow is corrected immediately (it
+    costs fidelity via the merge-overflow slot), while downward probes —
+    pure fidelity *improvements* — only fire when the bracket allows a
+    ≥25% step, so knife-edge pixels sit on the fitting side instead of
+    dipping into overflow every other frame. Each frame the bracket
+    decays outward by ``track`` (lo shrinking, hi growing) so a drifting
+    scene re-opens the search window instead of being pinned by stale
+    bounds."""
+    over = count > max_k
+    under = count < int(max_k * (1.0 - delta))
+    thr, lo, hi = state
+
+    lo = jnp.where(over, thr, lo)
+    hi = jnp.where(~over, jnp.minimum(hi, thr), hi)
+    # a drifting scene can invert a decayed bracket; when it is, fall back
+    # to a multiplicative step (over: ×1.5 up, under: ×0.75 down)
+    up = 0.5 * (thr + jnp.where(hi > thr, hi, 2.0 * thr))
+    dn = 0.5 * (thr + jnp.where(lo < thr, lo, 0.5 * thr))
+    new = jnp.where(over, up,
+                    jnp.where(under & (dn <= 0.75 * thr), dn, thr))
+    new = jnp.clip(new, thr_min, thr_max)
+    # bracket decay: keeps tracking ability; bounds steady-state wobble
+    lo = jnp.maximum(jnp.float32(thr_min), lo * track)
+    hi = jnp.minimum(jnp.float32(thr_max), hi / track)
+    return ThresholdState(new, lo, hi)
+
+
 def pick_threshold(counts: jnp.ndarray, tvec: jnp.ndarray, max_k: int
                    ) -> jnp.ndarray:
     """Smallest candidate whose count is <= max_k (counts are non-

@@ -58,6 +58,11 @@ def generate_vdi(vol: Volume, tf: TransferFunction, cam: Camera,
         rgba = jnp.concatenate([jnp.moveaxis(rgb, -1, 0) * a[None], a[None]])
         return rgba, t - 0.5 * dt, t + 0.5 * dt
 
+    if cfg.adaptive and cfg.adaptive_mode == "temporal":
+        raise ValueError(
+            "adaptive_mode='temporal' is an MXU slice-march feature "
+            "(slicer.generate_vdi_mxu_temporal carries its per-frame "
+            "state); the gather path supports 'search' and 'histogram'")
     if cfg.adaptive and cfg.adaptive_mode == "histogram":
         # ONE counting march evaluating every candidate threshold (the
         # consecutive-item break metric makes count(thr) separable per

@@ -51,6 +51,12 @@ class SceneSession:
         self._slicer = _slicer
         self.engine = _slicer.resolve_engine(self.cfg.slicer.engine)
         self._steps = {}   # (regime, grid-set signature) -> jitted step
+        self._thr = {}      # same key -> carried temporal threshold state
+        self._thr_init = {}  # same key -> jitted threshold seeder
+        self._temporal = (self.cfg.runtime.generate_vdis
+                          and self.engine == "mxu"
+                          and self.cfg.vdi.adaptive
+                          and self.cfg.vdi.adaptive_mode == "temporal")
 
     # ------------------------------------------------- operator boundary
     def update_data(self, partner: int, grids, origins, spacing,
@@ -74,11 +80,18 @@ class SceneSession:
 
         drain_steering(self)
         with self.timers.phase("dispatch"):
-            step = self._step()
+            step, key = self._step()
             gs = self.scene.grids
-            out = step(tuple(g.volume.data for g in gs),
-                       tuple(g.volume.origin for g in gs),
-                       tuple(g.volume.spacing for g in gs), self.camera)
+            args = (tuple(g.volume.data for g in gs),
+                    tuple(g.volume.origin for g in gs),
+                    tuple(g.volume.spacing for g in gs), self.camera)
+            if self._temporal:
+                thr = self._thr.get(key)
+                if thr is None:     # seed on first frame of this regime
+                    thr = self._thr_init[key](*args)
+                out, self._thr[key] = step(*args, thr)
+            else:
+                out = step(*args)
         with self.timers.phase("fetch"):
             if self.cfg.runtime.generate_vdis:
                 vdi, meta = out
@@ -97,39 +110,73 @@ class SceneSession:
         return payload
 
     def _step(self):
-        """Jitted whole-scene step for the current camera regime and the
-        current grid-set SIGNATURE (shapes + ghosts are static; data,
-        origins, spacings and the camera are traced) — one compilation per
-        signature, like InSituSession._mxu_step. A driver that repartitions
-        (new shapes) triggers exactly one recompile."""
+        """(jitted step, cache key) for the current camera regime and the
+        current grid-set SIGNATURE — one compilation per signature, like
+        InSituSession._mxu_step. Data, origins, spacings and the camera
+        are traced; shapes + ghosts are static, and so is the mxu
+        intermediate-grid spec, whose dims derive from the scene's world
+        extent — hence the signature also carries the rounded global
+        bounds + spacing (a driver that repartitions, moves grids, or
+        changes resolution triggers exactly one recompile; same-extent
+        timestep updates reuse the cache)."""
         regime = self._slicer.choose_axis(self.camera)
         gs = self.scene.grids
         sig = tuple((tuple(g.volume.data.shape), g.ghost_lo, g.ghost_hi)
                     for g in gs)
-        key = (regime, sig, self.engine, self.cfg.runtime.generate_vdis)
+        lo, hi = self.scene.global_bounds()
+        sp = gs[0].volume.spacing
+        mxu_vdi = (self.cfg.runtime.generate_vdis and self.engine == "mxu")
+        # only the mxu spec bakes extent-derived statics; the gather/plain
+        # steps trace origins+spacings, so extent in THEIR key would force
+        # a recompile per scene movement for nothing
+        extent = tuple(round(float(x), 5) for arr in (lo, hi, sp)
+                       for x in np.asarray(arr)) if mxu_vdi else None
+        key = (regime, sig, extent, self.engine,
+               self.cfg.runtime.generate_vdis)
         step = self._steps.get(key)
         if step is not None:
-            return step
+            return step, key
 
         ghosts = [(g.ghost_lo, g.ghost_hi) for g in gs]
         r = self.cfg.render
         cfg = self.cfg
         tf = self.tf
         spec = None
-        if cfg.runtime.generate_vdis and self.engine == "mxu":
-            lo, hi = self.scene.global_bounds()
-            sp = gs[0].volume.spacing
+        if mxu_vdi:
             dims = tuple(int(round(float(d)))
                          for d in np.asarray((hi - lo) / sp))   # (x, y, z)
             spec = self._slicer.make_spec(self.camera,
                                           (dims[2], dims[1], dims[0]),
                                           cfg.slicer, axis_sign=regime)
 
-        def fn(datas, origins, spacings, cam):
+        def scene_of(datas, origins, spacings):
             sc = MultiGridScene()
             for i, (d, o, s) in enumerate(zip(datas, origins, spacings)):
                 sc.set_grid(0, i, d, o, s, *ghosts[i])
-            if cfg.runtime.generate_vdis and self.engine == "mxu":
+            return sc
+
+        if self._temporal:
+            def fn(datas, origins, spacings, cam, thr):
+                sc = scene_of(datas, origins, spacings)
+                return sc.generate_vdi_mxu_temporal(tf, cam, spec, thr,
+                                                    cfg.vdi, cfg.composite)
+
+            def fn_out(datas, origins, spacings, cam, thr):
+                out, meta, thr2 = fn(datas, origins, spacings, cam, thr)
+                return (out, meta), thr2
+
+            step = jax.jit(fn_out)
+            self._thr_init[key] = jax.jit(
+                lambda datas, origins, spacings, cam:
+                scene_of(datas, origins, spacings).initial_thresholds(
+                    tf, cam, spec, cfg.vdi))
+            self._steps[key] = step
+            self._evict()
+            return step, key
+
+        def fn(datas, origins, spacings, cam):
+            sc = scene_of(datas, origins, spacings)
+            if mxu_vdi:
                 return sc.generate_vdi_mxu(tf, cam, spec, cfg.vdi,
                                            cfg.composite)
             if cfg.runtime.generate_vdis:
@@ -140,4 +187,20 @@ class SceneSession:
 
         step = jax.jit(fn)
         self._steps[key] = step
-        return step
+        self._evict()
+        return step, key
+
+    _MAX_CACHED_STEPS = 8
+
+    def _evict(self):
+        """Bound the compiled-step / threshold caches: a drifting scene
+        mints a new extent key per movement, and an unbounded dict would
+        retain every stale executable + [G, nj, ni] threshold state for
+        the life of the session. Insertion order ≈ recency here (a key is
+        inserted once and then only hit), so dropping the oldest entries
+        is an adequate LRU."""
+        while len(self._steps) > self._MAX_CACHED_STEPS:
+            old = next(iter(self._steps))
+            self._steps.pop(old)
+            self._thr.pop(old, None)
+            self._thr_init.pop(old, None)

@@ -173,6 +173,12 @@ class InSituSession:
                  sim: Optional[VolumeSimAdapter] = None,
                  sinks: Sequence[Sink] = (), log=None):
         self.cfg = cfg or FrameworkConfig()
+        if self.cfg.vdi.adaptive and self.cfg.vdi.adaptive_mode == "temporal":
+            raise ValueError(
+                "InSituSession's distributed pipeline does not carry "
+                "temporal threshold state yet — use adaptive_mode="
+                "'histogram' here, or SceneSession / the single-chip "
+                "pipelines, which support 'temporal'")
         self.log = log or (lambda s: None)
         self.mesh = mesh if mesh is not None else make_mesh(
             self.cfg.mesh.num_devices, self.cfg.mesh.axis_name)

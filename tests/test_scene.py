@@ -180,3 +180,48 @@ def test_scene_session_external_driver(vol, tf, tmp_path):
     assert not np.array_equal(p1["vdi_color"], p2["vdi_color"])
     import glob as _glob
     assert len(_glob.glob(str(tmp_path / "frame*.png"))) == 2
+
+
+def test_scene_session_temporal_mode(vol, tf):
+    """SceneSession with adaptive_mode='temporal': threshold state is
+    seeded on the first frame, threaded across frames, and re-seeded when
+    the grid-set signature changes (repartition)."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.runtime.scene_session import SceneSession
+
+    cfg = FrameworkConfig().with_overrides(
+        "vdi.max_supersegments=4", "vdi.adaptive_mode=temporal",
+        "composite.max_output_supersegments=6", "composite.adaptive_iters=1",
+        "slicer.engine=mxu", "slicer.matmul_dtype=f32",
+        "runtime.dataset=procedural")
+    sess = SceneSession(cfg)
+    assert sess._temporal
+
+    data = np.asarray(vol.data)
+    sess.update_data(0, [data], [np.asarray(vol.origin)], vol.spacing)
+    p1 = sess.render_frame()
+    assert np.isfinite(p1["vdi_color"]).all()
+    assert len(sess._thr) == 1
+    thr1 = next(iter(sess._thr.values()))
+    assert thr1.thr.shape[0] == 1      # one grid
+
+    p2 = sess.render_frame()        # carried state, same compiled step
+    assert np.isfinite(p2["vdi_color"]).all()
+    assert len(sess._steps) == 1
+
+    # moving the scene (same shapes, new extent) must recompile the step
+    # (stale-spec guard) and seed a fresh threshold entry
+    sess.update_data(0, [data], [np.asarray(vol.origin) + 1.5], vol.spacing)
+    p3 = sess.render_frame()
+    assert np.isfinite(p3["vdi_color"]).all()
+    assert len(sess._steps) == 2
+    assert len(sess._thr) == 2
+
+
+def test_insitu_session_rejects_temporal():
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.runtime.session import InSituSession
+
+    cfg = FrameworkConfig().with_overrides("vdi.adaptive_mode=temporal")
+    with pytest.raises(ValueError, match="temporal"):
+        InSituSession(cfg)

@@ -76,6 +76,11 @@ def grayscott_vdi_frame_step(width: int, height: int,
         raise ValueError("adaptive_mode='temporal' needs engine='mxu'")
 
     def frame_step(u, v, eye, thr=None):
+        if temporal and thr is None:
+            raise ValueError(
+                "temporal mode carries threshold state: call as "
+                "frame_step(u, v, eye, thr), seeding thr with "
+                "frame_step.init_threshold(u, v, eye)")
         state = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
         vol = Volume.centered(state.field, extent=2.0)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)

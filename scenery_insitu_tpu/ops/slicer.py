@@ -646,7 +646,7 @@ def _vdi_meta(vol: Volume, axcam: AxisCamera, ni: int, nj: int,
 def _histogram_threshold(march, cfg: VDIConfig, k: int, nj: int, ni: int
                          ) -> jnp.ndarray:
     """One counting march for ALL candidate thresholds at once."""
-    tvec = ss.threshold_candidates(cfg.histogram_bins)
+    tvec = ss.threshold_candidates(cfg.histogram_bins, cfg.thr_max)
 
     def consume_multi(st, rgba, t0, t1):
         for i in range(rgba.shape[0]):

@@ -53,6 +53,7 @@ class SceneSession:
         self._steps = {}   # (regime, grid-set signature) -> jitted step
         self._thr = {}      # same key -> carried temporal threshold state
         self._thr_init = {}  # same key -> jitted threshold seeder
+        self._extent_cache = None  # (lo, hi, sp, rounded tuple) host copy
         self._temporal = (self.cfg.runtime.generate_vdis
                           and self.engine == "mxu"
                           and self.cfg.vdi.adaptive
@@ -64,10 +65,12 @@ class SceneSession:
         """≅ updateData(partnerNo, numGrids, grids, origins, ...)."""
         self.scene.update_data(partner, grids, origins, spacing,
                                ghost_lo, ghost_hi)
+        self._extent_cache = None
 
     def update_grid(self, partner: int, gid: int, data) -> None:
         """≅ updateVolume(id, buffer) — new timestep for one grid."""
         self.scene.update_grid(partner, gid, data)
+        self._extent_cache = None
 
     # -------------------------------------------------------------- frames
     def render_frame(self) -> dict:
@@ -123,14 +126,23 @@ class SceneSession:
         gs = self.scene.grids
         sig = tuple((tuple(g.volume.data.shape), g.ghost_lo, g.ghost_hi)
                     for g in gs)
-        lo, hi = self.scene.global_bounds()
-        sp = gs[0].volume.spacing
         mxu_vdi = (self.cfg.runtime.generate_vdis and self.engine == "mxu")
         # only the mxu spec bakes extent-derived statics; the gather/plain
         # steps trace origins+spacings, so extent in THEIR key would force
-        # a recompile per scene movement for nothing
-        extent = tuple(round(float(x), 5) for arr in (lo, hi, sp)
-                       for x in np.asarray(arr)) if mxu_vdi else None
+        # a recompile per scene movement for nothing. The extent is cached
+        # host-side (invalidated by update_data/update_grid) so cache-hit
+        # frames never sync device values on the dispatch path.
+        extent = None
+        lo = hi = sp = None
+        if mxu_vdi:
+            if self._extent_cache is None:
+                lo, hi = self.scene.global_bounds()
+                sp = gs[0].volume.spacing
+                self._extent_cache = (
+                    lo, hi, sp,
+                    tuple(round(float(x), 5) for arr in (lo, hi, sp)
+                          for x in np.asarray(arr)))
+            lo, hi, sp, extent = self._extent_cache
         key = (regime, sig, extent, self.engine,
                self.cfg.runtime.generate_vdis)
         step = self._steps.get(key)

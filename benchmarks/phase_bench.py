@@ -86,8 +86,8 @@ def main():
         gs.GrayScott(u, v, gs.GrayScottParams.create()), args.sim_steps))
 
     def gen(local, o, s, c):
-        vdi, meta, _ = _mxu_rank_generate(local, o, s, c, slicer, spec, tf,
-                                          vdi_cfg, axis, n)
+        vdi, meta, _, _ = _mxu_rank_generate(local, o, s, c, slicer, spec,
+                                             tf, vdi_cfg, axis, n)
         return vdi.color, vdi.depth
 
     gen_fn = jax.jit(jax.shard_map(

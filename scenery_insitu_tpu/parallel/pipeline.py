@@ -111,10 +111,9 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
     return jax.jit(f)
 
 
-def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
-                       tf, vdi_cfg, axis, n):
-    """Per-rank slice-march VDI generation on a z-slab (shared by the
-    distributed VDI and hybrid steps). Returns (vdi, meta, axcam)."""
+def _rank_slab(local_data, origin, spacing, spec, axis, n):
+    """This rank's halo-padded slab Volume + global box + ownership bounds
+    for a slice march (shared by generation and threshold seeding)."""
     r = jax.lax.axis_index(axis)
     dn = local_data.shape[0]
     h, w = local_data.shape[1], local_data.shape[2]
@@ -142,13 +141,29 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
         # the last rank only re-admits pos == global max, which the
         # volume-extent mask in _interp_matrix still caps
         v_bounds = (z_lo, jnp.where(r == n - 1, z_hi + dz, z_hi))
+    return vol, gmax, v_bounds, (w, h, dn * n)
 
-    vdi, meta, axcam = slicer.generate_vdi_mxu(
-        vol, tf, cam, spec, vdi_cfg,
-        box_min=origin, box_max=gmax, v_bounds=v_bounds)
+
+def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
+                       tf, vdi_cfg, axis, n, threshold=None):
+    """Per-rank slice-march VDI generation on a z-slab (shared by the
+    distributed VDI and hybrid steps). Returns (vdi, meta, axcam,
+    next_threshold) — the last is None unless carried temporal threshold
+    state was passed in."""
+    vol, gmax, v_bounds, dims = _rank_slab(local_data, origin, spacing,
+                                           spec, axis, n)
+    if threshold is None:
+        vdi, meta, axcam = slicer.generate_vdi_mxu(
+            vol, tf, cam, spec, vdi_cfg,
+            box_min=origin, box_max=gmax, v_bounds=v_bounds)
+        thr2 = None
+    else:
+        vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
+            vol, tf, cam, spec, threshold, vdi_cfg,
+            box_min=origin, box_max=gmax, v_bounds=v_bounds)
     # metadata must describe the GLOBAL volume, not this rank's slab
-    meta = meta._replace(volume_dims=jnp.array([w, h, dn * n], jnp.float32))
-    return vdi, meta, axcam
+    meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
+    return vdi, meta, axcam, thr2
 
 
 def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
@@ -169,6 +184,16 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
     `distributed_vdi_step`; ownership of in-plane samples is half-open per
     rank, halo rows make boundary interpolation seam-exact.
     """
+    return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
+                           temporal=False)
+
+
+def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
+                    temporal: bool):
+    """Shared builder of the MXU sort-last step (generate → column
+    all_to_all → composite), with or without carried temporal threshold
+    state threaded through."""
+    from scenery_insitu_tpu.core.vdi import VDIMetadata
     from scenery_insitu_tpu.ops import slicer
 
     vdi_cfg = vdi_cfg or VDIConfig()
@@ -179,21 +204,96 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
 
-    def step(local_data, origin, spacing, cam: Camera):
-        vdi, meta, _ = _mxu_rank_generate(local_data, origin, spacing, cam,
-                                          slicer, spec, tf, vdi_cfg, axis, n)
+    def body(local_data, origin, spacing, cam, thr):
+        vdi, meta, _, thr2 = _mxu_rank_generate(local_data, origin,
+                                                spacing, cam, slicer, spec,
+                                                tf, vdi_cfg, axis, n,
+                                                threshold=thr)
         colors = _exchange_columns(vdi.color, n, axis)     # [n,K,4,Nj,Ni/n]
         depths = _exchange_columns(vdi.depth, n, axis)
-        return composite_vdis(colors, depths, comp_cfg), meta
+        return composite_vdis(colors, depths, comp_cfg), meta, thr2
 
     spec_vol = P(axis, None, None)
-    from scenery_insitu_tpu.core.vdi import VDIMetadata
     out_vdi = VDI(P(None, None, None, axis), P(None, None, None, axis))
     out_meta = VDIMetadata(*(P() for _ in VDIMetadata._fields))
-    f = shard_map(step, mesh=mesh,
-                  in_specs=(spec_vol, P(), P(), P()),
-                  out_specs=(out_vdi, out_meta), check_vma=False)
+
+    if temporal:
+        thr_spec = _thr_state_spec(axis)
+
+        def step(local_data, origin, spacing, cam: Camera, thr):
+            out, meta, thr2 = body(local_data, origin, spacing, cam, thr)
+            return (out, meta), thr2
+
+        f = shard_map(step, mesh=mesh,
+                      in_specs=(spec_vol, P(), P(), P(), thr_spec),
+                      out_specs=((out_vdi, out_meta), thr_spec),
+                      check_vma=False)
+    else:
+        def step(local_data, origin, spacing, cam: Camera):
+            out, meta, _ = body(local_data, origin, spacing, cam, None)
+            return out, meta
+
+        f = shard_map(step, mesh=mesh,
+                      in_specs=(spec_vol, P(), P(), P()),
+                      out_specs=(out_vdi, out_meta), check_vma=False)
     return jax.jit(f)
+
+
+def _thr_state_spec(axis):
+    """Sharding spec of the distributed temporal ThresholdState: each
+    rank's [nj, ni] maps stack on a leading rank axis → global
+    [n*nj, ni] arrays, rank-sharded."""
+    from scenery_insitu_tpu.ops import supersegments as ss
+
+    return ss.ThresholdState(
+        *(P(axis, None) for _ in ss.ThresholdState._fields))
+
+
+def distributed_initial_threshold_mxu(mesh: Mesh, tf: TransferFunction,
+                                      spec,
+                                      vdi_cfg: Optional[VDIConfig] = None,
+                                      axis_name: Optional[str] = None):
+    """Jitted seeder for `distributed_vdi_step_mxu_temporal`: one
+    histogram counting march per rank on its own slab. Returns
+    ``f(vol_data (z-sharded), origin, spacing, cam) -> ThresholdState``
+    with rank-stacked [n*nj, ni] maps."""
+    from scenery_insitu_tpu.ops import slicer
+
+    vdi_cfg = vdi_cfg or VDIConfig()
+    axis = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis]
+
+    def seed(local_data, origin, spacing, cam: Camera):
+        vol, gmax, v_bounds, _ = _rank_slab(local_data, origin, spacing,
+                                            spec, axis, n)
+        return slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg,
+                                        box_min=origin, box_max=gmax,
+                                        v_bounds=v_bounds)
+
+    f = shard_map(seed, mesh=mesh,
+                  in_specs=(P(axis, None, None), P(), P(), P()),
+                  out_specs=_thr_state_spec(axis), check_vma=False)
+    return jax.jit(f)
+
+
+def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
+                                      spec,
+                                      vdi_cfg: Optional[VDIConfig] = None,
+                                      comp_cfg: Optional[CompositeConfig]
+                                      = None,
+                                      axis_name: Optional[str] = None):
+    """`distributed_vdi_step_mxu` with carried per-rank temporal threshold
+    state (adaptive_mode="temporal": ONE march per rank per frame instead
+    of counting + write — see slicer.generate_vdi_mxu_temporal).
+
+    Returns ``f(vol_data (z-sharded), origin, spacing, cam, thr) ->
+    ((VDI, meta), thr')`` where thr is the rank-sharded ThresholdState
+    from `distributed_initial_threshold_mxu`. Each rank adapts the
+    threshold map of its own generation camera footprint; the sort-last
+    exchange and composite are unchanged.
+    """
+    return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
+                           temporal=True)
 
 
 def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
@@ -229,9 +329,9 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
                          f"mesh size {n}")
 
     def step(local_data, origin, spacing, tr_pos, tr_vel, cam: Camera):
-        vdi, meta, axcam = _mxu_rank_generate(local_data, origin, spacing,
-                                              cam, slicer, spec, tf,
-                                              vdi_cfg, axis, n)
+        vdi, meta, axcam, _ = _mxu_rank_generate(local_data, origin,
+                                                 spacing, cam, slicer,
+                                                 spec, tf, vdi_cfg, axis, n)
         colors = _exchange_columns(vdi.color, n, axis)
         depths = _exchange_columns(vdi.depth, n, axis)
         comp = composite_vdis(colors, depths, comp_cfg)    # [Ko,·,Nj,Ni/n]

@@ -173,12 +173,6 @@ class InSituSession:
                  sim: Optional[VolumeSimAdapter] = None,
                  sinks: Sequence[Sink] = (), log=None):
         self.cfg = cfg or FrameworkConfig()
-        if self.cfg.vdi.adaptive and self.cfg.vdi.adaptive_mode == "temporal":
-            raise ValueError(
-                "InSituSession's distributed pipeline does not carry "
-                "temporal threshold state yet — use adaptive_mode="
-                "'histogram' here, or SceneSession / the single-chip "
-                "pipelines, which support 'temporal'")
         self.log = log or (lambda s: None)
         self.mesh = mesh if mesh is not None else make_mesh(
             self.cfg.mesh.num_devices, self.cfg.mesh.axis_name)
@@ -210,6 +204,7 @@ class InSituSession:
         # pipeline; plain-image mode always uses the gather path
         self.engine = _slicer.resolve_engine(self.cfg.slicer.engine)
         self._mxu_steps = {}   # (axis, sign) -> jitted distributed step
+        self._mxu_thr = {}     # (axis, sign) -> temporal threshold state
         self.mode = "vdi"
         if isinstance(self.sim, ParticleSimAdapter):
             # sort-first sphere rendering (≅ InVisRenderer + Head)
@@ -237,6 +232,15 @@ class InSituSession:
             self.mode = "plain"
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r)
+
+        if (self.cfg.vdi.adaptive
+                and self.cfg.vdi.adaptive_mode == "temporal"
+                and not (self.mode == "vdi" and self.engine == "mxu")):
+            raise ValueError(
+                "adaptive_mode='temporal' is carried threshold state of "
+                "the MXU VDI pipeline — this session resolved to mode="
+                f"{self.mode!r} engine={self.engine!r}; use 'histogram' "
+                "there")
 
         # world placement: sim grid centered, largest side = 2 world units
         if self.mode == "particles":
@@ -379,8 +383,12 @@ class InSituSession:
     def _mxu_step(self):
         """Jitted MXU distributed step for the camera's current march
         regime; one compilation per (axis, sign), cached (the camera may
-        orbit across axis boundaries mid-session)."""
-        from scenery_insitu_tpu.parallel.pipeline import distributed_vdi_step_mxu
+        orbit across axis boundaries mid-session). In temporal mode the
+        returned callable seeds and threads the per-regime threshold
+        state internally, so callers see the same 4-arg signature."""
+        from scenery_insitu_tpu.parallel.pipeline import (
+            distributed_initial_threshold_mxu, distributed_vdi_step_mxu,
+            distributed_vdi_step_mxu_temporal)
 
         regime = self._slicer.choose_axis(self.camera)
         step = self._mxu_steps.get(regime)
@@ -389,8 +397,26 @@ class InSituSession:
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
-            step = distributed_vdi_step_mxu(self.mesh, self.tf, spec,
-                                            self.cfg.vdi, self.cfg.composite)
+            if (self.cfg.vdi.adaptive
+                    and self.cfg.vdi.adaptive_mode == "temporal"):
+                inner = distributed_vdi_step_mxu_temporal(
+                    self.mesh, self.tf, spec, self.cfg.vdi,
+                    self.cfg.composite)
+                seed = distributed_initial_threshold_mxu(
+                    self.mesh, self.tf, spec, self.cfg.vdi)
+
+                def step(field, origin, spacing, cam,
+                         _regime=regime, _inner=inner, _seed=seed):
+                    thr = self._mxu_thr.get(_regime)
+                    if thr is None:
+                        thr = _seed(field, origin, spacing, cam)
+                    out, self._mxu_thr[_regime] = _inner(
+                        field, origin, spacing, cam, thr)
+                    return out
+            else:
+                step = distributed_vdi_step_mxu(
+                    self.mesh, self.tf, spec, self.cfg.vdi,
+                    self.cfg.composite)
             self._mxu_steps[regime] = step
         return step
 

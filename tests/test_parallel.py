@@ -156,3 +156,45 @@ def test_distributed_vdi_mxu_matches_single(eye):
     img = np.asarray(render_vdi(vdi, meta, cam, W, H, steps=STEPS))
     q = psnr(ref, img)
     assert q > 27.0, f"PSNR {q:.1f} dB at eye {eye}"
+
+
+@pytest.mark.parametrize("eye", [(0.0, 0.2, 4.0),    # march axis z (sharded)
+                                 (3.8, 0.3, 0.6)])   # march axis x (in-plane z)
+def test_distributed_vdi_mxu_temporal_matches_histogram(eye):
+    """Distributed temporal mode (per-rank carried threshold, one march
+    per frame) converges to the same composited VDI quality as the
+    per-frame histogram mode, in both march regimes."""
+    from scenery_insitu_tpu.config import SliceMarchConfig
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.ops.vdi_render import render_vdi
+    from scenery_insitu_tpu.parallel.pipeline import (
+        distributed_initial_threshold_mxu, distributed_vdi_step_mxu,
+        distributed_vdi_step_mxu_temporal)
+
+    n = 4
+    mesh = make_mesh(n)
+    vol = procedural_volume(16, kind="blobs")
+    cam = Camera.create(eye, fov_y_deg=50.0, near=0.5, far=20.0)
+    tf = _tf()
+    comp = CompositeConfig(max_output_supersegments=16)
+    spec = slicer.make_spec(cam, vol.data.shape,
+                            SliceMarchConfig(matmul_dtype="f32", scale=1.5))
+    data = shard_volume(vol.data, mesh)
+
+    cfg_h = VDIConfig(max_supersegments=10, adaptive_mode="histogram")
+    vdi_h, meta_h = distributed_vdi_step_mxu(mesh, tf, spec, cfg_h, comp)(
+        data, vol.origin, vol.spacing, cam)
+    ref = np.asarray(render_vdi(vdi_h, meta_h, cam, W, H, steps=STEPS))
+
+    cfg_t = VDIConfig(max_supersegments=10, adaptive_mode="temporal")
+    thr = distributed_initial_threshold_mxu(mesh, tf, spec, cfg_t)(
+        data, vol.origin, vol.spacing, cam)
+    assert thr.thr.shape == (n * spec.nj, spec.ni)   # rank-stacked maps
+    step_t = distributed_vdi_step_mxu_temporal(mesh, tf, spec, cfg_t, comp)
+    for _ in range(3):
+        (vdi_t, meta_t), thr = step_t(data, vol.origin, vol.spacing, cam,
+                                      thr)
+    img = np.asarray(render_vdi(vdi_t, meta_t, cam, W, H, steps=STEPS))
+    assert np.isfinite(img).all()
+    q = psnr(ref, img)
+    assert q > 27.0, f"PSNR {q:.1f} dB at eye {eye}"

@@ -73,6 +73,24 @@ def test_session_mxu_engine(tmp_path):
     assert len(s._mxu_steps) == 1
 
 
+def test_session_mxu_temporal(tmp_path):
+    """Session with carried temporal threshold state on the distributed
+    MXU pipeline: seeded on the first frame of a regime, threaded after."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+
+    cfg = FrameworkConfig().with_overrides(
+        "slicer.engine=mxu", "slicer.scale=1.0",
+        "sim.grid=[16,16,16]", "sim.steps_per_frame=2",
+        "vdi.max_supersegments=6", "vdi.adaptive_mode=temporal",
+        "composite.max_output_supersegments=8", "mesh.num_devices=4")
+    s = InSituSession(cfg)
+    payload = s.run(3)
+    assert np.isfinite(payload["vdi_color"]).all()
+    assert len(s._mxu_thr) == 1             # one regime seeded
+    thr = next(iter(s._mxu_thr.values()))
+    assert np.isfinite(np.asarray(thr.thr)).all()
+
+
 def test_session_particle_mode():
     cfg = _cfg(**{"sim.kind": "lennard_jones", "sim.num_particles": 64,
                   "sim.particle_radius": 0.3})

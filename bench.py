@@ -211,25 +211,13 @@ def _child_env(platform: str) -> dict:
 
 
 def _probe_tpu() -> bool:
-    """Can the TPU backend actually answer? A dead tunnel HANGS instead of
-    erroring, so this must be a subprocess with a hard timeout — and must
-    run BEFORE committing the full benchmark to the TPU attempt. Raise
-    SITPU_BENCH_PROBE_TIMEOUT on clusters with slow cold backend init (a
-    probe false-negative demotes the headline number to the CPU fallback;
-    the second platforms entry retries the probe)."""
-    timeout_s = _env_int("SITPU_BENCH_PROBE_TIMEOUT", 150)
-    code = ("import jax\n"
-            "assert jax.devices()[0].platform == 'tpu'\n"
-            "import jax.numpy as jnp\n"
-            "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))\n")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              env=dict(os.environ), timeout=timeout_s,
-                              stdout=subprocess.DEVNULL,
-                              stderr=subprocess.DEVNULL)
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    """Can the TPU backend actually answer? Must run BEFORE committing the
+    full benchmark to the TPU attempt (a probe false-negative demotes the
+    headline number to the CPU fallback; the second platforms entry
+    retries the probe). One shared implementation: utils.backend."""
+    from scenery_insitu_tpu.utils.backend import probe_tpu
+
+    return probe_tpu() > 0
 
 
 def _run_child(platform: str, timeout_s: int):

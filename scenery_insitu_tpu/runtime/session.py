@@ -233,9 +233,15 @@ class InSituSession:
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r)
 
+        self._temporal = (self.cfg.vdi.adaptive
+                          and self.cfg.vdi.adaptive_mode == "temporal"
+                          and self.mode == "vdi" and self.engine == "mxu")
+        # particle/plain modes never consult cfg.vdi — only reject the
+        # modes that would hit the slicer's temporal-needs-state error at
+        # trace time (gather VDI generation, hybrid's VDI pass)
         if (self.cfg.vdi.adaptive
                 and self.cfg.vdi.adaptive_mode == "temporal"
-                and not (self.mode == "vdi" and self.engine == "mxu")):
+                and not self._temporal and self.mode in ("vdi", "hybrid")):
             raise ValueError(
                 "adaptive_mode='temporal' is carried threshold state of "
                 "the MXU VDI pipeline — this session resolved to mode="
@@ -391,14 +397,20 @@ class InSituSession:
             distributed_vdi_step_mxu_temporal)
 
         regime = self._slicer.choose_axis(self.camera)
+        # regime switch: drop the entered regime's carried threshold so it
+        # re-seeds — state frozen many frames ago (while the camera was in
+        # another regime, with the sim evolving) would take the controller
+        # several overflow-degraded frames to walk back
+        if regime != getattr(self, "_last_regime", regime):
+            self._mxu_thr.pop(regime, None)
+        self._last_regime = regime
         step = self._mxu_steps.get(regime)
         if step is None:
             n = self.mesh.shape[self.cfg.mesh.axis_name]
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
-            if (self.cfg.vdi.adaptive
-                    and self.cfg.vdi.adaptive_mode == "temporal"):
+            if self._temporal:
                 inner = distributed_vdi_step_mxu_temporal(
                     self.mesh, self.tf, spec, self.cfg.vdi,
                     self.cfg.composite)

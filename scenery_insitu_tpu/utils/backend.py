@@ -44,6 +44,31 @@ def virtual_mesh_env(n_devices: int, base: dict = None) -> dict:
     return env
 
 
+def probe_tpu(timeout_s: int = None) -> int:
+    """Device count of a LIVE TPU backend, else 0. Must be a subprocess
+    with a hard timeout — a dead tunnel HANGS backend access instead of
+    erroring — and validates a real matmul, not just device enumeration.
+    ``timeout_s`` defaults to $SITPU_BENCH_PROBE_TIMEOUT or 150 (raise it
+    on clusters with slow cold backend init)."""
+    import subprocess
+
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("SITPU_BENCH_PROBE_TIMEOUT", 150))
+    code = ("import jax\n"
+            "assert jax.devices()[0].platform == 'tpu'\n"
+            "import jax.numpy as jnp\n"
+            "assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) > 0\n"
+            "print(jax.device_count())\n")
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           env=dict(os.environ), timeout=timeout_s,
+                           stdout=subprocess.PIPE,
+                           stderr=subprocess.DEVNULL)
+        return int(p.stdout.strip() or 0) if p.returncode == 0 else 0
+    except (subprocess.TimeoutExpired, ValueError):
+        return 0
+
+
 def reexec_virtual_mesh(n_devices: int, marker: str) -> None:
     """Replace this process with a copy running on an n-device virtual CPU
     mesh; ``marker`` is the env flag that breaks the recursion (the child

@@ -1,0 +1,80 @@
+"""Streamed-VDI client: subscribe to a VDI stream, render novel views
+locally, steer the producer's camera — the counterpart of the reference's
+remote-viewer chain (ZMQ VDI transport + EfficientVDIRaycast novel-view
+rendering + camera messages back, VolumeFromFileExample.kt:996-1046).
+
+Pair with examples/insitu_grayscott.py --publish or
+examples/volume_from_file.py --publish:
+
+    python examples/vdi_client.py --connect tcp://localhost:6655 \
+        --frames 10 --out client_out/
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--connect", default="tcp://localhost:6655")
+    ap.add_argument("--frames", type=int, default=10)
+    ap.add_argument("--out", default="client_out")
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--height", type=int, default=512)
+    ap.add_argument("--yaw", type=float, default=0.15,
+                    help="novel-view offset (radians) from the stream pose")
+    ap.add_argument("--steer", default="",
+                    help="ZMQ address of the producer's steering endpoint")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from scenery_insitu_tpu.core.camera import Camera, orbit
+    from scenery_insitu_tpu.ops import vdi_novel
+    from scenery_insitu_tpu.ops.vdi_render import render_vdi
+    from scenery_insitu_tpu.runtime.streaming import VDISubscriber
+    from scenery_insitu_tpu.utils.image import save_png
+
+    os.makedirs(args.out, exist_ok=True)
+    sub = VDISubscriber(args.connect)
+    steer = None
+    if args.steer:
+        from scenery_insitu_tpu.runtime.streaming import SteeringPublisher
+        steer = SteeringPublisher(args.steer)
+
+    print(f"listening on {args.connect} …")
+    for i in range(args.frames):
+        got = sub.receive(timeout_ms=30000)
+        if got is None:
+            print("no VDI within 30 s; is a producer publishing?")
+            break
+        vdi, meta = got
+        # rebuild the generating camera's slice geometry from METADATA ONLY
+        spec0 = vdi_novel.axis_spec_from_meta(meta)
+        axcam0 = vdi_novel.axis_camera_from_meta(meta, spec0)
+        cam = Camera.create(tuple(np.linalg.inv(
+            np.asarray(meta.view))[:3, 3]), fov_y_deg=50.0,
+            near=0.3, far=20.0)
+        novel = orbit(cam, args.yaw)
+        try:
+            img = vdi_novel.render_vdi_mxu(vdi, axcam0, spec0, novel,
+                                           args.width, args.height)
+        except ValueError:
+            # novel view left the generating march regime: portable path
+            img = render_vdi(vdi, meta, novel, args.width, args.height)
+        save_png(os.path.join(args.out, f"novel{i:03d}.png"),
+                 np.asarray(img))
+        print(f"frame {int(meta.index)}: rendered novel view "
+              f"({i + 1}/{args.frames})")
+        if steer is not None:
+            from scenery_insitu_tpu.runtime.streaming import (
+                make_camera_message)
+            steer.send(make_camera_message(novel))
+    sub.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,9 @@ def save_session(sess: "InSituSession", path: str) -> None:
         "version": _VERSION,
         "sim_kind": sess.sim.kind,
         "mode": sess.mode,
+        "engine": sess.engine,
+        "temporal": bool(getattr(sess, "_temporal", False)),
+        "mesh_devices": int(sess.mesh.shape[sess.cfg.mesh.axis_name]),
         "frame_index": sess.frame_index,
         "orbit_rate": float(sess.orbit_rate),
         "thr_regimes": sorted(sess._mxu_thr.keys()),
@@ -108,6 +111,18 @@ def load_session(sess: "InSituSession", path: str) -> None:
             raise ValueError(
                 f"checkpoint mode {header['mode']!r} does not match "
                 f"session {sess.mode!r}")
+        # bit-exact resume needs the same compiled step: engine, adaptive
+        # regime and mesh size all change what the resumed run computes
+        for key, have in (("engine", sess.engine),
+                          ("temporal", bool(getattr(sess, "_temporal",
+                                                    False))),
+                          ("mesh_devices",
+                           int(sess.mesh.shape[sess.cfg.mesh.axis_name]))):
+            want = header.get(key)
+            if want is not None and want != have:
+                raise ValueError(
+                    f"checkpoint {key}={want!r} does not match session "
+                    f"{have!r} — same config required")
         sim_arrays = {k.split("/", 1)[1]: z[k]
                       for k in z.files if k.startswith("sim/")}
         want = _sim_arrays(sess.sim)

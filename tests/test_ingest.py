@@ -193,3 +193,65 @@ def test_session_driven_by_external_cpp_sim():
     finally:
         proc.kill()
         proc.wait(timeout=10)
+
+
+def test_concurrent_stress_no_torn_frames():
+    """Race stress (the reference ships NO race detection — SURVEY §5):
+    one producer process-thread publishing checksummed frames as fast as
+    possible, two consumer threads reading concurrently with and without
+    copy. Every observed frame must be internally consistent (checksum
+    matches its sequence stamp) and sequences must be non-decreasing per
+    consumer — i.e. no torn reads, no reordering, under real contention."""
+    chan = _chan()
+    shape = (64, 257)      # odd second dim: exercises unaligned strides
+    frames = 400
+    prod = ShmProducer(chan, shape, nslots=4)
+    stop = threading.Event()
+    errors = []
+
+    def producer():
+        base = np.empty(shape, np.float32)
+        for i in range(1, frames + 1):
+            base.fill(float(i))
+            base[-1, -1] = i * 2.0    # tail stamp: torn-write detector
+            prod.publish(base)
+        stop.set()
+
+    def consumer(copy: bool):
+        con = ShmConsumer(chan, shape, timeout_ms=2000)
+        last = 0.0
+        try:
+            while not stop.is_set() or last == 0.0:
+                got = con.latest(timeout_ms=200, copy=copy)
+                if got is None:
+                    continue
+                frame, _seq = got
+                head = float(frame[0, 0])
+                tail = float(frame[-1, -1])
+                mid = float(frame[shape[0] // 2, shape[1] // 2])
+                if not copy:
+                    con.release(frame.slot)
+                if head < last:
+                    errors.append(f"value went backwards {last} -> {head}")
+                if tail != head * 2.0 or mid != head:
+                    errors.append(
+                        f"torn frame {head}: tail {tail} mid {mid}")
+                last = head
+        except Exception as e:      # surfaced by the main thread
+            errors.append(repr(e))
+        finally:
+            con.close()
+
+    ths = [threading.Thread(target=consumer, args=(True,)),
+           threading.Thread(target=consumer, args=(False,))]
+    for t in ths:
+        t.start()
+    try:
+        producer()
+    finally:
+        stop.set()      # a producer error must not leave consumers spinning
+    for t in ths:
+        t.join(timeout=30)
+        assert not t.is_alive(), "consumer thread wedged"
+    prod.close()
+    assert not errors, errors[:5]

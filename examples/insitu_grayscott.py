@@ -26,6 +26,10 @@ def main():
                     help="camera radians/frame")
     ap.add_argument("--publish", default="",
                     help="ZMQ bind address to stream VDIs (e.g. tcp://*:6655)")
+    ap.add_argument("--steer-bind", default="",
+                    help="ZMQ bind address accepting camera steering "
+                         "messages (e.g. tcp://*:6656; pair with "
+                         "vdi_client.py --steer)")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", default="", help="checkpoint to resume from")
     ap.add_argument("--cpu", action="store_true",
@@ -58,6 +62,9 @@ def main():
                                                           stream_sink)
         sinks.append(stream_sink(VDIPublisher(args.publish)))
     sess = InSituSession(cfg, sinks=sinks)
+    if args.steer_bind:
+        from scenery_insitu_tpu.runtime.streaming import SteeringEndpoint
+        sess.steering = SteeringEndpoint(args.steer_bind)
     sess.orbit_rate = args.orbit
     if args.checkpoint_every:
         sess.sinks.append(checkpoint_sink(

@@ -27,7 +27,9 @@ def main():
     ap.add_argument("--yaw", type=float, default=0.15,
                     help="novel-view offset (radians) from the stream pose")
     ap.add_argument("--steer", default="",
-                    help="ZMQ address of the producer's steering endpoint")
+                    help="ZMQ address of the producer's steering endpoint "
+                         "(insitu_grayscott.py --steer-bind; "
+                         "volume_from_file.py does not steer)")
     args = ap.parse_args()
 
     import numpy as np

@@ -62,7 +62,8 @@ def main():
         if args.store_vdis or pub is not None:
             vdi, meta, _ = slicer.generate_vdi_mxu(
                 vol, tf, cam, spec,
-                VDIConfig(max_supersegments=args.k, adaptive_iters=4))
+                VDIConfig(max_supersegments=args.k, adaptive_iters=4),
+                frame_index=i)
             if args.store_vdis:
                 from scenery_insitu_tpu.io.vdi_io import save_vdi
                 save_vdi(os.path.join(args.out, f"vdi{i:03d}.npz"),

@@ -198,7 +198,8 @@ def checkpoint_sink(directory: str, every: int = 50):
         def __call__(self, index: int, payload: dict) -> None:
             if self.sess is not None and every and index % every == 0:
                 os.makedirs(directory, exist_ok=True)
+                # zero-padded so lexicographic order == frame order
                 save_session(self.sess, os.path.join(
-                    directory, f"ckpt_{self.sess.frame_index}.npz"))
+                    directory, f"ckpt_{self.sess.frame_index:05d}.npz"))
 
     return _Sink()

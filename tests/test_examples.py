@@ -1,0 +1,73 @@
+"""Smoke tests for the examples/ CLIs (the reference's app-entry-point
+roles) — run as real subprocesses on tiny sizes so the documented
+commands keep working."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EX = os.path.join(_ROOT, "examples")
+
+
+def _run(script, *args, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_EX_CHILD"] = "1"      # examples pin the cpu backend themselves
+    return subprocess.run(
+        [sys.executable, os.path.join(_EX, script), *args],
+        env=env, timeout=timeout, capture_output=True, text=True)
+
+
+def test_insitu_example_with_checkpoint_and_resume(tmp_path):
+    out = str(tmp_path / "out")
+    p = _run("insitu_grayscott.py", "--frames", "4", "--grid", "24",
+             "--out", out, "--checkpoint-every", "2", "--orbit", "0.02")
+    assert p.returncode == 0, p.stderr[-800:]
+    assert len(glob.glob(os.path.join(out, "frame*.png"))) == 4
+    ckpts = sorted(glob.glob(os.path.join(out, "ckpt_*.npz")))
+    assert ckpts
+
+    p = _run("insitu_grayscott.py", "--frames", "2", "--grid", "24",
+             "--out", out, "--resume", ckpts[-1])
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "resumed at frame" in p.stdout
+
+
+def test_volume_from_file_example(tmp_path):
+    out = str(tmp_path / "views")
+    p = _run("volume_from_file.py", "--out", out, "--views", "2",
+             "--width", "48", "--height", "48", "--store-vdis")
+    assert p.returncode == 0, p.stderr[-800:]
+    assert len(glob.glob(os.path.join(out, "view*.png"))) == 2
+    assert len(glob.glob(os.path.join(out, "vdi*.npz"))) == 2
+
+
+def test_producer_client_pair(tmp_path):
+    pytest.importorskip("zmq")
+    out = str(tmp_path / "client")
+    port = 16655 + os.getpid() % 1000
+    client = subprocess.Popen(
+        [sys.executable, os.path.join(_EX, "vdi_client.py"),
+         "--connect", f"tcp://localhost:{port}", "--frames", "1",
+         "--width", "48", "--height", "48", "--out", out],
+        env={**os.environ, "PYTHONPATH": _ROOT, "JAX_PLATFORMS": "cpu",
+             "_EX_CHILD": "1"},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        import time
+        time.sleep(2)          # let the SUB socket connect
+        p = _run("volume_from_file.py", "--out", str(tmp_path / "v"),
+                 "--views", "3", "--width", "32", "--height", "32",
+                 "--publish", f"tcp://*:{port}")
+        assert p.returncode == 0, p.stderr[-800:]
+        client.wait(timeout=300)
+        assert client.returncode == 0, client.stdout.read()[-800:]
+        assert glob.glob(os.path.join(out, "novel*.png"))
+    finally:
+        if client.poll() is None:
+            client.kill()

@@ -220,8 +220,10 @@ def test_concurrent_stress_no_torn_frames():
     def consumer(copy: bool):
         con = ShmConsumer(chan, shape, timeout_ms=2000)
         last = 0.0
+        deadline = time.time() + 60     # bound the never-saw-a-frame case
         try:
-            while not stop.is_set() or last == 0.0:
+            while ((not stop.is_set() or last == 0.0)
+                   and time.time() < deadline):
                 got = con.latest(timeout_ms=200, copy=copy)
                 if got is None:
                     continue

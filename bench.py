@@ -80,6 +80,12 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from scenery_insitu_tpu.utils.backend import enable_compile_cache
+
+    # repeat runs (driver retries, the platform fallback chain) skip the
+    # ~25 s flagship compile
+    enable_compile_cache()
+
     from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
     from scenery_insitu_tpu.core.camera import Camera, orbit
     from scenery_insitu_tpu.models.pipelines import grayscott_vdi_frame_step

@@ -44,6 +44,23 @@ def virtual_mesh_env(n_devices: int, base: dict = None) -> dict:
     return env
 
 
+def enable_compile_cache() -> None:
+    """Point JAX at a persistent compilation cache so repeat runs of the
+    bench / dry-run entry points skip the ~25 s flagship compile.
+    Per-user default dir (a fixed world-shared path could be squatted or
+    unwritable on multi-user hosts); $SITPU_JAX_CACHE overrides. Safe on
+    any JAX version — silently a no-op where unsupported."""
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("SITPU_JAX_CACHE",
+                           f"/tmp/sitpu_jax_cache-{os.getuid()}"))
+    except Exception:
+        pass
+
+
 def probe_tpu(timeout_s: int = None) -> int:
     """Device count of a LIVE TPU backend, else 0. Must be a subprocess
     with a hard timeout — a dead tunnel HANGS backend access instead of

@@ -61,6 +61,25 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
 
     k_out = cfg.max_output_supersegments
 
+    if (assume_sorted and n == 1 and k_out >= k and cfg.adaptive
+            and cfg.backend == "auto"):
+        # Single already-segmented ray with enough output slots: the input
+        # is returned verbatim (padded to K_out). This intentionally
+        # differs from the merge fold, whose adaptive search floor
+        # (thr_max / 2^iters) re-merges segments whose RGB differs by up
+        # to ~0.03 — pure information loss when everything already fits.
+        # Identity is the DEFINED behavior for the default backend; an
+        # explicit backend= request ("xla"/"pallas") still runs the real
+        # fold so kernel parity checks and timings stay meaningful.
+        pad = k_out - k
+        color = jnp.concatenate(
+            [flat_c, jnp.zeros((pad,) + flat_c.shape[1:], flat_c.dtype)]) \
+            if pad else flat_c
+        depth = jnp.concatenate(
+            [flat_d, jnp.full((pad,) + flat_d.shape[1:], jnp.inf,
+                              flat_d.dtype)]) if pad else flat_d
+        return VDI(color, depth)
+
     backend = cfg.backend
     if backend == "auto":
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"

@@ -47,16 +47,19 @@ def virtual_mesh_env(n_devices: int, base: dict = None) -> dict:
 def enable_compile_cache() -> None:
     """Point JAX at a persistent compilation cache so repeat runs of the
     bench / dry-run entry points skip the ~25 s flagship compile.
-    Per-user default dir (a fixed world-shared path could be squatted or
-    unwritable on multi-user hosts); $SITPU_JAX_CACHE overrides. Safe on
-    any JAX version — silently a no-op where unsupported."""
+    Default dir lives under the user's home (a /tmp path could be
+    pre-created — squatted — by another local user, who would then own
+    the dir the deserialized executables come from); $SITPU_JAX_CACHE
+    overrides. Safe on any JAX version — silently a no-op where
+    unsupported."""
     try:
         import jax
 
+        default = os.path.join(
+            os.path.expanduser("~"), ".cache", "sitpu_jax_cache")
         jax.config.update(
             "jax_compilation_cache_dir",
-            os.environ.get("SITPU_JAX_CACHE",
-                           f"/tmp/sitpu_jax_cache-{os.getuid()}"))
+            os.environ.get("SITPU_JAX_CACHE", default))
     except Exception:
         pass
 

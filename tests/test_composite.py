@@ -106,3 +106,43 @@ def test_depth_min_composite():
     img, d = np.asarray(img), np.asarray(d)
     assert img[0, 0, 0] == np.float32(0.2) and img[0, 0, 1] == np.float32(0.7)
     assert d[0, 0] == 1.0 and d[0, 1] == 2.0
+
+
+def test_n1_composite_is_identity_pad():
+    """N=1 with K_out >= K and the default backend: the composite's
+    defined behavior is the verbatim input padded with empty slots (the
+    merge fold's search floor would re-merge for no gain) — and it must
+    render like the real fold, which explicit backends still run."""
+    from scenery_insitu_tpu.config import VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.vdi import render_vdi_same_view
+    from scenery_insitu_tpu.core.volume import procedural_volume
+    from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+    from scenery_insitu_tpu.utils.image import psnr
+
+    vol = procedural_volume(32, kind="blobs", seed=5)
+    tf = for_dataset("procedural")
+    cam = Camera.create((0.1, 0.4, 2.8), fov_y_deg=45.0, near=0.3, far=12.0)
+    vdi, _ = generate_vdi(vol, tf, cam, 48, 40,
+                          VDIConfig(max_supersegments=8, adaptive_iters=3),
+                          max_steps=96)
+
+    out = composite_vdis(vdi.color[None], vdi.depth[None],
+                         CompositeConfig(max_output_supersegments=10))
+    np.testing.assert_array_equal(np.asarray(out.color[:8]),
+                                  np.asarray(vdi.color))
+    np.testing.assert_array_equal(np.asarray(out.depth[:8]),
+                                  np.asarray(vdi.depth))
+    assert float(out.color[8:, 3].max()) == 0.0     # padding is empty
+    assert np.isinf(np.asarray(out.depth[8:])).all()
+
+    # an explicitly requested backend still runs the real merge fold, and
+    # the two stay visually equivalent
+    slow = composite_vdis(vdi.color[None], vdi.depth[None],
+                          CompositeConfig(max_output_supersegments=10,
+                                          backend="xla"))
+    a = render_vdi_same_view(out)
+    b = render_vdi_same_view(slow)
+    q = psnr(np.asarray(b), np.asarray(a))
+    assert q > 40.0, f"PSNR {q:.1f} dB"

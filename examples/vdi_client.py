@@ -36,7 +36,6 @@ def main():
 
     from scenery_insitu_tpu.core.camera import Camera, orbit
     from scenery_insitu_tpu.ops import vdi_novel
-    from scenery_insitu_tpu.ops.vdi_render import render_vdi
     from scenery_insitu_tpu.runtime.streaming import VDISubscriber
     from scenery_insitu_tpu.utils.image import save_png
 
@@ -61,12 +60,10 @@ def main():
             np.asarray(meta.view))[:3, 3]), fov_y_deg=50.0,
             near=0.3, far=20.0)
         novel = orbit(cam, args.yaw)
-        try:
-            img = vdi_novel.render_vdi_mxu(vdi, axcam0, spec0, novel,
-                                           args.width, args.height)
-        except ValueError:
-            # novel view left the generating march regime: portable path
-            img = render_vdi(vdi, meta, novel, args.width, args.height)
+        # any-view: same-regime plane sweep, or cross-regime via the
+        # pre-shaded proxy volume — gather-free either way
+        img = vdi_novel.render_vdi_any(vdi, axcam0, spec0, novel,
+                                       args.width, args.height)
         save_png(os.path.join(args.out, f"novel{i:03d}.png"),
                  np.asarray(img))
         print(f"frame {int(meta.index)}: rendered novel view "

@@ -21,7 +21,10 @@ import numpy as np
 
 
 class Volume(NamedTuple):
-    data: jnp.ndarray      # f32[D, H, W] normalized scalar field, vol[z, y, x]
+    # f32[D, H, W] normalized scalar field, vol[z, y, x] — or, for
+    # pre-shaded content (the novel-view proxy), f32[ch, D, H, W] with a
+    # leading channel dim (premultiplied RGBA; rendered without a TF)
+    data: jnp.ndarray
     origin: jnp.ndarray    # f32[3] world position of min corner (x, y, z)
     spacing: jnp.ndarray   # f32[3] world size of a voxel (x, y, z)
 
@@ -43,7 +46,7 @@ class Volume(NamedTuple):
 
     @property
     def dims_xyz(self) -> Tuple[int, int, int]:
-        d, h, w = self.data.shape
+        d, h, w = self.data.shape[-3:]
         return (w, h, d)
 
     @property
@@ -52,7 +55,7 @@ class Volume(NamedTuple):
 
     @property
     def world_max(self) -> jnp.ndarray:
-        d, h, w = self.data.shape
+        d, h, w = self.data.shape[-3:]
         return self.origin + jnp.array([w, h, d], jnp.float32) * self.spacing
 
     def world_to_voxel(self, p: jnp.ndarray) -> jnp.ndarray:

@@ -62,8 +62,10 @@ from scenery_insitu_tpu.ops import supersegments as ss
 from scenery_insitu_tpu.ops.raycast import RaycastOutput, nominal_step
 from scenery_insitu_tpu.ops.sampling import adjust_opacity
 
-# xyz axis index -> data dim of Volume.data [z, y, x]
-_DATA_DIM = {0: 2, 1: 1, 2: 0}
+# xyz axis index -> data dim of Volume.data [..., z, y, x], counted from
+# the END so an optional leading channel dim (pre-shaded RGBA volumes)
+# never shifts the lookup
+_DATA_DIM = {0: -1, 1: -2, 2: -3}
 # march axis -> (u axis, v axis), both xyz indices
 _UV = {2: (0, 1), 1: (0, 2), 0: (1, 2)}
 
@@ -170,10 +172,15 @@ class AxisCamera(NamedTuple):
 
 
 def permute_volume(vol: Volume, spec: AxisSpec) -> jnp.ndarray:
-    """Volume data -> march layout ``[S, Nv, Nu]`` (slice, in-plane v, u),
-    flipped so marched slice index ascends front-to-back."""
-    perm = {2: (0, 1, 2), 1: (1, 0, 2), 0: (2, 0, 1)}[spec.axis]
-    volp = jnp.transpose(vol.data, perm)
+    """Volume data -> march layout ``[S, (ch,) Nv, Nu]`` (slice, optional
+    channels, in-plane v, u), flipped so marched slice index ascends
+    front-to-back. A leading channel dim of pre-shaded RGBA volumes moves
+    BEHIND the slice dim so the march can slab-slice on dim 0."""
+    nd = vol.data.ndim
+    perm3 = {2: (0, 1, 2), 1: (1, 0, 2), 0: (2, 0, 1)}[spec.axis]
+    dims = [nd - 3 + p for p in perm3]
+    volp = jnp.transpose(vol.data,
+                         [dims[0]] + list(range(nd - 3)) + dims[1:])
     if spec.sign < 0:
         volp = jnp.flip(volp, axis=0)
     return volp
@@ -307,6 +314,10 @@ def chunk_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
         pad = nchunks * c - s_total
         volp = jnp.concatenate(
             [volp, jnp.zeros((pad,) + volp.shape[1:], volp.dtype)], axis=0)
+    if vol.data.ndim == 4:
+        # pre-shaded RGBA: a slab is visible iff any stored alpha is
+        alpha = volp[:, 3]
+        return alpha.reshape(nchunks, -1).max(axis=1) > alpha_eps
     slabs = volp.reshape(nchunks, -1)
     lo = jnp.clip(jnp.min(slabs, axis=1), 0.0, 1.0)
     hi = jnp.clip(jnp.max(slabs, axis=1), 0.0, 1.0)
@@ -325,6 +336,11 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     rgba is premultiplied, already opacity-corrected for the per-ray
     inter-slice path length, and zero outside the volume/ownership bounds.
 
+    Pre-shaded RGBA volumes (``vol.data f32[4, D, H, W]``, premultiplied,
+    alpha encoded for a ``nominal_step(vol)``-long traversal — the
+    novel-view proxy) march without a transfer function: pass ``tf=None``
+    and the per-slice shading resamples the stored channels instead.
+
     ``occupancy`` (bool[nchunks], from `chunk_occupancy`) skips the
     resampling matmuls and fold for provably-empty chunks; the skipped
     branch still feeds ONE all-empty sample so stream-gap semantics
@@ -333,6 +349,7 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     the predicate turns true (alpha-saturation early-out, ≅ the
     reference's early exit in AccumulatePlainImage.comp:8-13).
     """
+    pre_shaded = vol.data.ndim == 4
     volp = permute_volume(vol, spec)
     s_total = volp.shape[0]
     c = spec.chunk
@@ -366,26 +383,44 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
         sk = jnp.float32(spec.sign) * (wk - ew) / axcam.zp   # depth ratios
         live = (sk > spec.s_floor) & (ks < s_total)
 
-        slices = jax.lax.dynamic_slice_in_dim(volp, ci * c, c, 0)  # [C,Nv,Nu]
+        slices = jax.lax.dynamic_slice_in_dim(volp, ci * c, c, 0)
 
         pos_u = eu + (axcam.u_grid[None, :] - eu) * sk[:, None]    # [C, Ni]
         pos_v = ev + (axcam.v_grid[None, :] - ev) * sk[:, None]    # [C, Nj]
         wu = _interp_matrix(pos_u, ou, su, nu, u_bounds)           # [C,Ni,Nu]
         wv = _interp_matrix(pos_v, ov, sv, nv, v_bounds)           # [C,Nj,Nv]
 
-        val = jnp.einsum("cjy,cyx,cix->cji",
-                         wv.astype(mm), slices.astype(mm), wu.astype(mm),
-                         preferred_element_type=jnp.float32)
-        val = jnp.clip(val, 0.0, 1.0)
-
-        rgb, alpha = tf(val)                               # [C,Nj,Ni,3], [C,Nj,Ni]
-        # outside-volume samples must be fully transparent even when the
-        # transfer function maps value 0 to nonzero alpha
         inside = (wv.sum(-1) > 0.0)[:, :, None] & (wu.sum(-1) > 0.0)[:, None, :]
-        alpha = jnp.where(inside & live[:, None, None], alpha, 0.0)
-        alpha = adjust_opacity(alpha, ratio[None])
-        rgba = jnp.concatenate(
-            [jnp.moveaxis(rgb, -1, 1) * alpha[:, None], alpha[:, None]], axis=1)
+        keep = inside & live[:, None, None]
+        if pre_shaded:
+            # stored premultiplied RGBA; alpha encoded per nominal step
+            val = jnp.einsum("cjy,cdyx,cix->cdji",
+                             wv.astype(mm), slices.astype(mm),
+                             wu.astype(mm),
+                             preferred_element_type=jnp.float32)
+            a_res = jnp.clip(val[:, 3], 0.0, 1.0 - 1e-6)
+            a_res = jnp.where(keep, a_res, 0.0)
+            alpha = adjust_opacity(a_res, ratio[None])
+            # premultiplied rgb scales with its alpha re-correction
+            scale = alpha / jnp.maximum(a_res, 1e-6)
+            rgba = jnp.concatenate(
+                [jnp.clip(val[:, :3], 0.0, 1.0) * scale[:, None],
+                 alpha[:, None]], axis=1)
+        else:
+            val = jnp.einsum("cjy,cyx,cix->cji",
+                             wv.astype(mm), slices.astype(mm),
+                             wu.astype(mm),
+                             preferred_element_type=jnp.float32)
+            val = jnp.clip(val, 0.0, 1.0)
+
+            rgb, alpha = tf(val)                   # [C,Nj,Ni,3], [C,Nj,Ni]
+            # outside-volume samples must be fully transparent even when
+            # the transfer function maps value 0 to nonzero alpha
+            alpha = jnp.where(keep, alpha, 0.0)
+            alpha = adjust_opacity(alpha, ratio[None])
+            rgba = jnp.concatenate(
+                [jnp.moveaxis(rgb, -1, 1) * alpha[:, None],
+                 alpha[:, None]], axis=1)
 
         t0 = sk[:, None, None] * length[None]
         t1 = (sk + ds)[:, None, None] * length[None]

@@ -143,6 +143,159 @@ def decode_slice(vdi: VDI, t: jnp.ndarray, dt_ref: jnp.ndarray
                            axis=1)
 
 
+def _default_slices(ni0: int) -> int:
+    """Static plane-count heuristic when the generating volume's true
+    slice count is unknown: intermediate grids are sized ~1.25× the
+    in-plane voxel count and volumes are roughly cubic."""
+    return max(16, int(round(ni0 / 1.25)))
+
+
+def _content_aabb(vdi: VDI, axcam0: AxisCamera, s_count: int):
+    """In-plane world extent of the marched frustum content over the VDI's
+    actual depth range (traced; shared by the plane-sweep renderer's new
+    grid and the proxy volume's target grid). Returns
+    (u_lo, u_hi, v_lo, v_hi, smax)."""
+    eu0, ev0 = axcam0.eye_u, axcam0.eye_v
+    length0 = axcam0.ray_lengths()
+    ds0 = jnp.abs(axcam0.dwm) / axcam0.zp
+    ends = vdi.depth[:, 1]
+    s_of_end = jnp.where(jnp.isfinite(ends), ends, 0.0) / length0[None]
+    smax = jnp.clip(jnp.max(s_of_end), 1.0, 1.0 + ds0 * s_count)
+    u_vals = jnp.stack([axcam0.u_grid[0], axcam0.u_grid[-1],
+                        eu0 + (axcam0.u_grid[0] - eu0) * smax,
+                        eu0 + (axcam0.u_grid[-1] - eu0) * smax])
+    v_vals = jnp.stack([axcam0.v_grid[0], axcam0.v_grid[-1],
+                        ev0 + (axcam0.v_grid[0] - ev0) * smax,
+                        ev0 + (axcam0.v_grid[-1] - ev0) * smax])
+    return (jnp.min(u_vals), jnp.max(u_vals),
+            jnp.min(v_vals), jnp.max(v_vals), smax)
+
+
+def vdi_to_rgba_volume(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
+                       num_slices: Optional[int] = None):
+    """Expand a slice-march VDI into an axis-aligned pre-shaded RGBA proxy
+    volume (``Volume`` with data f32[4, D, H, W], premultiplied, alpha
+    encoded per ``nominal_step``) — gather-free: each original slice plane
+    is decoded (masked reduction over K) and resampled from its uniform
+    perspective grid onto a regular world grid with the same banded-matmul
+    machinery as the forward march (the plane's depth ratio is constant,
+    so the frustum→AABB warp is separable per plane).
+
+    This is the bridge to CROSS-REGIME novel views: the proxy renders
+    through the ordinary slice march along ANY axis (`render_vdi_any`),
+    where the same-axis plane sweep (`render_vdi_mxu`) cannot order the
+    planes front-to-back. Resolution follows the VDI's own grid (in-plane)
+    and the original march's plane count (depth): the proxy adds one
+    bilinear resample of loss on top of the VDI's own quantization.
+    """
+    from scenery_insitu_tpu.core.volume import Volume
+
+    k, _, nj0, ni0 = vdi.color.shape
+    if num_slices is None:
+        num_slices = _default_slices(ni0)
+    s_count = num_slices
+    a, ua, va = spec0.axis, spec0.u_axis, spec0.v_axis
+
+    eu0, ev0, ew0 = axcam0.eye_u, axcam0.eye_v, axcam0.eye_w
+    length0 = axcam0.ray_lengths()                         # [Nj0, Ni0]
+    du0 = axcam0.u_grid[1] - axcam0.u_grid[0]
+    dv0 = axcam0.v_grid[1] - axcam0.v_grid[0]
+
+    # world AABB of the marched frustum content: in-plane extent at the
+    # deepest live depth ratio (shared with render_vdi_mxu)
+    u_lo, u_hi, v_lo, v_hi, _ = _content_aabb(vdi, axcam0, s_count)
+
+    nu_t, nv_t = ni0, nj0                                  # static
+    sp_u = (u_hi - u_lo) / nu_t
+    sp_v = (v_hi - v_lo) / nv_t
+    dw = jnp.abs(axcam0.dwm)
+    # ascending-world target grids (Volume layout wants min-corner origin)
+    tu = u_lo + (jnp.arange(nu_t, dtype=jnp.float32) + 0.5) * sp_u
+    tv = v_lo + (jnp.arange(nv_t, dtype=jnp.float32) + 0.5) * sp_v
+    nominal = jnp.minimum(jnp.minimum(sp_u, sp_v), dw)
+
+    c = spec0.chunk
+    nchunks = -(-s_count // c)
+
+    def body(_, ci):
+        q = ci * c + jnp.arange(c, dtype=jnp.float32)      # march order
+        wq = axcam0.w0 + q * axcam0.dwm                    # [C] plane w
+        s0 = jnp.float32(spec0.sign) * (wq - ew0) / axcam0.zp
+        live = (q < s_count) & (s0 > spec0.s_floor)
+        # dead planes are zeroed below, but their arithmetic must stay
+        # finite (s0 == 0 would put NaNs through the interp weights)
+        s0 = jnp.where(live, s0, 1.0)
+        t_at = s0[:, None, None] * length0[None]
+        dt_ref = jnp.broadcast_to(nominal, t_at.shape)
+        src = decode_slice(vdi, t_at, dt_ref)[:, :4]       # drop dt chan
+
+        # plane's uniform source grid: scaled about the eye by s0
+        su_org = eu0 + (axcam0.u_grid[0] - eu0) * s0       # [C]
+        su_sp = du0 * s0
+        sv_org = ev0 + (axcam0.v_grid[0] - ev0) * s0
+        sv_sp = dv0 * s0
+        wu = _interp_matrix(jnp.broadcast_to(tu, (c, nu_t)),
+                            su_org, su_sp, ni0)            # [C, nu_t, Ni0]
+        wv = _interp_matrix(jnp.broadcast_to(tv, (c, nv_t)),
+                            sv_org, sv_sp, nj0)            # [C, nv_t, Nj0]
+        mm = jnp.bfloat16 if spec0.matmul_dtype == "bf16" else jnp.float32
+        plane = jnp.einsum("cjy,cdyx,cix->cdji",
+                           wv.astype(mm), src.astype(mm), wu.astype(mm),
+                           preferred_element_type=jnp.float32)
+        plane = plane * live[:, None, None, None].astype(jnp.float32)
+        return None, plane
+
+    _, planes = jax.lax.scan(body, None, jnp.arange(nchunks))
+    stack = planes.reshape(nchunks * c, 4, nv_t, nu_t)[:s_count]
+
+    # march order ascends w only for sign>0; Volume wants ascending w
+    if spec0.sign < 0:
+        stack = jnp.flip(stack, axis=0)
+        w_min = axcam0.w0 + (s_count - 1) * axcam0.dwm
+    else:
+        w_min = axcam0.w0
+
+    data = jnp.moveaxis(stack, 1, 0)                       # [4, w, v, u]
+    # arrange (w, v, u) -> (z, y, x) for the volume layout
+    if a == 2:                                             # w=z, v=y, u=x
+        pass
+    elif a == 1:                                           # w=y, v=z, u=x
+        data = jnp.transpose(data, (0, 2, 1, 3))
+    else:                                                  # w=x, v=z, u=y
+        data = jnp.transpose(data, (0, 2, 3, 1))
+    origin = jnp.zeros(3).at[ua].set(u_lo).at[va].set(v_lo) \
+        .at[a].set(w_min - 0.5 * dw)
+    spacing = jnp.zeros(3).at[ua].set(sp_u).at[va].set(sp_v).at[a].set(dw)
+    return Volume(data, origin, spacing)
+
+
+def render_vdi_any(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
+                   cam: Camera, width: int, height: int,
+                   num_slices: Optional[int] = None,
+                   background: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0),
+                   axis_sign: Optional[Tuple[int, int]] = None,
+                   slicer_cfg=None) -> jnp.ndarray:
+    """Gather-free novel-view rendering from ANY camera: same-regime views
+    use the direct plane sweep (`render_vdi_mxu`); cross-regime views
+    expand the VDI into the pre-shaded proxy volume once and slice-march
+    it along the new camera's own axis (≅ EfficientVDIRaycast.comp's
+    arbitrary-view capability, re-derived as two matmul passes instead of
+    per-pixel binary searches)."""
+    new_axis, new_sign = axis_sign or slicer.choose_axis(cam)
+    if new_axis == spec0.axis:
+        return render_vdi_mxu(vdi, axcam0, spec0, cam, width, height,
+                              num_slices=num_slices, background=background,
+                              axis_sign=(new_axis, new_sign))
+    proxy = vdi_to_rgba_volume(vdi, axcam0, spec0, num_slices=num_slices)
+    from scenery_insitu_tpu.config import SliceMarchConfig
+    cfg = slicer_cfg or SliceMarchConfig(matmul_dtype=spec0.matmul_dtype)
+    spec_new = slicer.make_spec(cam, proxy.data.shape[-3:], cfg,
+                                axis_sign=(new_axis, new_sign))
+    out = slicer.raycast_mxu(proxy, None, cam, width, height, spec_new,
+                             background=background)
+    return out.image
+
+
 def render_vdi_mxu(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
                    cam: Camera, width: int, height: int,
                    num_slices: Optional[int] = None,
@@ -188,7 +341,7 @@ def render_vdi_mxu(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
     # depth ladder: the original march's slice planes (count must be
     # static; see docstring for the default heuristic)
     if num_slices is None:
-        num_slices = max(16, int(round(ni0 / 1.25)))
+        num_slices = _default_slices(ni0)
     s_count = num_slices
 
     eu0, ev0, ew0 = axcam0.eye_u, axcam0.eye_v, axcam0.eye_w
@@ -219,15 +372,7 @@ def render_vdi_mxu(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
     # over the VDI's ACTUAL depth range (traced values may size the box —
     # only the pixel counts must stay static); a loose box wastes
     # intermediate resolution and blurs the resample
-    ends = vdi.depth[:, 1]
-    s_of_end = jnp.where(jnp.isfinite(ends), ends, 0.0) / length0[None]
-    smax = jnp.clip(jnp.max(s_of_end), 1.0, 1.0 + ds0 * s_count)
-    u_lo = jnp.minimum(axcam0.u_grid[0], eu0 + (axcam0.u_grid[0] - eu0) * smax)
-    u_hi = jnp.maximum(axcam0.u_grid[-1], eu0 + (axcam0.u_grid[-1] - eu0) * smax)
-    v_vals = jnp.stack([axcam0.v_grid[0], axcam0.v_grid[-1],
-                        ev0 + (axcam0.v_grid[0] - ev0) * smax,
-                        ev0 + (axcam0.v_grid[-1] - ev0) * smax])
-    v_lo, v_hi = jnp.min(v_vals), jnp.max(v_vals)
+    u_lo, u_hi, v_lo, v_hi, smax = _content_aabb(vdi, axcam0, s_count)
     w_far = ew0 + jnp.float32(spec0.sign) * smax * axcam0.zp
     w_lo = jnp.minimum(plane_w(0.0), w_far)
     w_hi = jnp.maximum(plane_w(0.0), w_far)

@@ -141,3 +141,25 @@ def test_session_profile_trace(tmp_path):
     import glob as _glob
     assert _glob.glob(str(tmp_path / "trace" / "**" / "*.xplane.pb"),
                       recursive=True)
+
+
+def test_session_soak_state_bounded():
+    """60-frame soak with an orbiting camera crossing march regimes:
+    caches stay bounded, threshold state tracks the live regimes only,
+    output stays finite (guards against stateful leaks in the temporal /
+    compiled-step caches over long runs)."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+
+    cfg = FrameworkConfig().with_overrides(
+        "slicer.engine=mxu", "slicer.scale=1.0",
+        "sim.grid=[12,12,12]", "sim.steps_per_frame=1",
+        "vdi.max_supersegments=4", "vdi.adaptive_mode=temporal",
+        "composite.max_output_supersegments=4", "mesh.num_devices=2")
+    s = InSituSession(cfg)
+    s.orbit_rate = 0.12        # ~57 frames per revolution: crosses regimes
+    payload = s.run(60)
+    assert np.isfinite(payload["vdi_color"]).all()
+    # 4 regimes visited at most around one orbit in a horizontal plane
+    assert len(s._mxu_steps) <= 4
+    assert len(s._mxu_thr) <= 4
+    assert len(s._pending_meta) <= 2   # metadata snapshots are drained

@@ -78,6 +78,21 @@ def main():
         num_slices=g, axis_sign=regime))
     t_mxu = timed(mxu, "mxu plane sweep")
 
+    # cross-regime: a view marching a different axis goes through the
+    # pre-shaded proxy volume — built ONCE per VDI, reused per view
+    from scenery_insitu_tpu.ops.vdi_novel import (render_vdi_any,
+                                                  vdi_to_rgba_volume)
+    proxy = jax.jit(lambda: vdi_to_rgba_volume(vdi, axcam, spec,
+                                               num_slices=g))()
+    jax.block_until_ready(proxy.data)
+    cam_x = Camera.create((2.9, 0.2, 0.3), fov_y_deg=45.0, near=0.3,
+                          far=10.0)
+    regime_x = slicer.choose_axis(cam_x)
+    cross = jax.jit(lambda yaw: render_vdi_any(
+        vdi, axcam, spec, orbit(cam_x, yaw), args.width, args.height,
+        num_slices=g, axis_sign=regime_x, proxy=proxy))
+    t_cross = timed(cross, "cross-regime proxy")
+
     t_gather = None
     if not args.skip_gather:
         gather = jax.jit(lambda yaw: render_vdi(
@@ -89,6 +104,7 @@ def main():
         "metric": f"novel_view_{g}c_{args.width}x{args.height}_ms",
         "value": round(t_mxu * 1000, 2),
         "unit": "ms/frame",
+        "cross_regime_ms": round(t_cross * 1000, 2),
         "gather_ms": round(t_gather * 1000, 2) if t_gather else None,
         "speedup_vs_gather": round(t_gather / t_mxu, 1) if t_gather else None,
         "backend": jax.default_backend(),

@@ -171,6 +171,34 @@ def _content_aabb(vdi: VDI, axcam0: AxisCamera, s_count: int):
             jnp.min(v_vals), jnp.max(v_vals), smax)
 
 
+def _resample_planes(vdi: VDI, axcam0: AxisCamera, s0: jnp.ndarray,
+                     dt_ref: jnp.ndarray, pos_u: jnp.ndarray,
+                     pos_v: jnp.ndarray, mm) -> jnp.ndarray:
+    """Shared per-plane kernel of both novel-view consumers: decode the
+    VDI on original planes at depth ratios ``s0 [C]`` (per-step alpha for
+    ``dt_ref``) and resample the decoded channels from each plane's
+    uniform perspective grid (the original grid scaled about the eye by
+    s0) onto per-plane sample positions ``pos_u [C, M] / pos_v [C, N]``.
+    Returns ``[C, 5, N, M]`` (rgb, alpha, dt_ref)."""
+    _, _, nj0, ni0 = vdi.color.shape
+    length0 = axcam0.ray_lengths()
+    t_at = s0[:, None, None] * length0[None]
+    src = decode_slice(vdi, t_at, jnp.broadcast_to(dt_ref, t_at.shape))
+
+    eu0, ev0 = axcam0.eye_u, axcam0.eye_v
+    du0 = axcam0.u_grid[1] - axcam0.u_grid[0]
+    dv0 = axcam0.v_grid[1] - axcam0.v_grid[0]
+    su_org = eu0 + (axcam0.u_grid[0] - eu0) * s0           # [C]
+    su_sp = du0 * s0
+    sv_org = ev0 + (axcam0.v_grid[0] - ev0) * s0
+    sv_sp = dv0 * s0
+    wu = _interp_matrix(pos_u, su_org, su_sp, ni0)         # [C, M, Ni0]
+    wv = _interp_matrix(pos_v, sv_org, sv_sp, nj0)         # [C, N, Nj0]
+    return jnp.einsum("cjy,cdyx,cix->cdji",
+                      wv.astype(mm), src.astype(mm), wu.astype(mm),
+                      preferred_element_type=jnp.float32)
+
+
 def vdi_to_rgba_volume(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
                        num_slices: Optional[int] = None):
     """Expand a slice-march VDI into an axis-aligned pre-shaded RGBA proxy
@@ -196,10 +224,7 @@ def vdi_to_rgba_volume(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
     s_count = num_slices
     a, ua, va = spec0.axis, spec0.u_axis, spec0.v_axis
 
-    eu0, ev0, ew0 = axcam0.eye_u, axcam0.eye_v, axcam0.eye_w
-    length0 = axcam0.ray_lengths()                         # [Nj0, Ni0]
-    du0 = axcam0.u_grid[1] - axcam0.u_grid[0]
-    dv0 = axcam0.v_grid[1] - axcam0.v_grid[0]
+    ew0 = axcam0.eye_w
 
     # world AABB of the marched frustum content: in-plane extent at the
     # deepest live depth ratio (shared with render_vdi_mxu)
@@ -217,6 +242,8 @@ def vdi_to_rgba_volume(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
     c = spec0.chunk
     nchunks = -(-s_count // c)
 
+    mm = jnp.bfloat16 if spec0.matmul_dtype == "bf16" else jnp.float32
+
     def body(_, ci):
         q = ci * c + jnp.arange(c, dtype=jnp.float32)      # march order
         wq = axcam0.w0 + q * axcam0.dwm                    # [C] plane w
@@ -225,23 +252,10 @@ def vdi_to_rgba_volume(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
         # dead planes are zeroed below, but their arithmetic must stay
         # finite (s0 == 0 would put NaNs through the interp weights)
         s0 = jnp.where(live, s0, 1.0)
-        t_at = s0[:, None, None] * length0[None]
-        dt_ref = jnp.broadcast_to(nominal, t_at.shape)
-        src = decode_slice(vdi, t_at, dt_ref)[:, :4]       # drop dt chan
-
-        # plane's uniform source grid: scaled about the eye by s0
-        su_org = eu0 + (axcam0.u_grid[0] - eu0) * s0       # [C]
-        su_sp = du0 * s0
-        sv_org = ev0 + (axcam0.v_grid[0] - ev0) * s0
-        sv_sp = dv0 * s0
-        wu = _interp_matrix(jnp.broadcast_to(tu, (c, nu_t)),
-                            su_org, su_sp, ni0)            # [C, nu_t, Ni0]
-        wv = _interp_matrix(jnp.broadcast_to(tv, (c, nv_t)),
-                            sv_org, sv_sp, nj0)            # [C, nv_t, Nj0]
-        mm = jnp.bfloat16 if spec0.matmul_dtype == "bf16" else jnp.float32
-        plane = jnp.einsum("cjy,cdyx,cix->cdji",
-                           wv.astype(mm), src.astype(mm), wu.astype(mm),
-                           preferred_element_type=jnp.float32)
+        plane = _resample_planes(
+            vdi, axcam0, s0, nominal,
+            jnp.broadcast_to(tu, (c, nu_t)),
+            jnp.broadcast_to(tv, (c, nv_t)), mm)[:, :4]    # drop dt chan
         plane = plane * live[:, None, None, None].astype(jnp.float32)
         return None, plane
 
@@ -274,19 +288,26 @@ def render_vdi_any(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
                    num_slices: Optional[int] = None,
                    background: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0),
                    axis_sign: Optional[Tuple[int, int]] = None,
-                   slicer_cfg=None) -> jnp.ndarray:
+                   slicer_cfg=None, proxy=None) -> jnp.ndarray:
     """Gather-free novel-view rendering from ANY camera: same-regime views
     use the direct plane sweep (`render_vdi_mxu`); cross-regime views
-    expand the VDI into the pre-shaded proxy volume once and slice-march
-    it along the new camera's own axis (≅ EfficientVDIRaycast.comp's
+    expand the VDI into the pre-shaded proxy volume and slice-march it
+    along the new camera's own axis (≅ EfficientVDIRaycast.comp's
     arbitrary-view capability, re-derived as two matmul passes instead of
-    per-pixel binary searches)."""
+    per-pixel binary searches).
+
+    ``proxy``: prebuilt `vdi_to_rgba_volume` result — the proxy depends
+    only on the VDI, so a client rendering several views of one received
+    VDI should build it once and pass it here instead of paying the
+    expansion per view."""
     new_axis, new_sign = axis_sign or slicer.choose_axis(cam)
     if new_axis == spec0.axis:
         return render_vdi_mxu(vdi, axcam0, spec0, cam, width, height,
                               num_slices=num_slices, background=background,
                               axis_sign=(new_axis, new_sign))
-    proxy = vdi_to_rgba_volume(vdi, axcam0, spec0, num_slices=num_slices)
+    if proxy is None:
+        proxy = vdi_to_rgba_volume(vdi, axcam0, spec0,
+                                   num_slices=num_slices)
     from scenery_insitu_tpu.config import SliceMarchConfig
     cfg = slicer_cfg or SliceMarchConfig(matmul_dtype=spec0.matmul_dtype)
     spec_new = slicer.make_spec(cam, proxy.data.shape[-3:], cfg,
@@ -405,17 +426,9 @@ def render_vdi_mxu(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
         q0 = orig_index(qn)                                # original idx
         wq = plane_w(q0)                                   # [C] plane w
 
-        # original grid on this plane: scale s0 about the original eye
+        # original-ladder depth ratio of this plane (always >= 1 on live
+        # planes — plane 0 sits on the reference plane itself)
         s0 = jnp.float32(spec0.sign) * (wq - ew0) / axcam0.zp
-        t_at = s0[:, None, None] * length0[None]           # [C, Nj0, Ni0]
-        dt0 = ds0 * length0                                # per-step len
-        src = decode_slice(vdi, t_at, jnp.broadcast_to(dt0, t_at.shape))
-
-        # source grid origin/spacing on the plane (uniform, per slice)
-        su_org = eu0 + (axcam0.u_grid[0] - eu0) * s0       # [C]
-        su_sp = du0 * s0
-        sv_org = ev0 + (axcam0.v_grid[0] - ev0) * s0
-        sv_sp = dv0 * s0
 
         # new camera's sample positions on the plane
         sn = jnp.float32(spec_new.sign) * (wq - ewn) / axcam_n.zp
@@ -423,12 +436,8 @@ def render_vdi_mxu(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
         pos_v = evn + (axcam_n.v_grid[None, :] - evn) * sn[:, None]
         front = sn > spec_new.s_floor                      # plane before eye
 
-        wu = _interp_matrix(pos_u, su_org, su_sp, ni0)     # [C, Nin, Ni0]
-        wv = _interp_matrix(pos_v, sv_org, sv_sp, nj0)     # [C, Njn, Nj0]
-
-        val = jnp.einsum("cjy,cdyx,cix->cdji",
-                         wv.astype(mm), src.astype(mm), wu.astype(mm),
-                         preferred_element_type=jnp.float32)
+        dt0 = ds0 * length0                                # per-step len
+        val = _resample_planes(vdi, axcam0, s0, dt0, pos_u, pos_v, mm)
         rgb = val[:, :3]
         a_res = jnp.clip(val[:, 3], 0.0, 1.0 - 1e-6)
         dt0_res = val[:, 4]

@@ -12,14 +12,16 @@ round-trip verification.
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def make_vdi(width: int, height: int, k: int, grid: int):
-    import jax.numpy as jnp
-
     from scenery_insitu_tpu.config import VDIConfig
     from scenery_insitu_tpu.core.camera import Camera
     from scenery_insitu_tpu.core.transfer import for_dataset

@@ -11,7 +11,6 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import jax.numpy as jnp
 
 
 def timeit(fn, args, n=5, label=""):

@@ -51,7 +51,7 @@ def main():
         pin_cpu_backend()
 
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from scenery_insitu_tpu.config import (CompositeConfig, SliceMarchConfig,
                                            VDIConfig)

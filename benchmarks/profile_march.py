@@ -69,7 +69,6 @@ def main():
         v = Volume.centered(data, extent=2.0)
         axcam = slicer.make_axis_camera(v, cam, spec)
         ident = lambda val: (jnp.stack([val] * 3, -1), val * 0.3)
-        from scenery_insitu_tpu.core.transfer import TransferFunction
         def consume(c, rgba, t0, t1):
             return c + rgba.sum((0, 1))
         return slicer.slice_march(v, ident, axcam, spec, consume,

@@ -27,7 +27,6 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from scenery_insitu_tpu.config import CompositeConfig, RenderConfig, VDIConfig
 from scenery_insitu_tpu.core.camera import Camera

@@ -14,7 +14,7 @@ Values are kept normalized to [0, 1]; loaders divide by the dtype range
 from __future__ import annotations
 
 import os
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
 import numpy as np

@@ -12,7 +12,7 @@ path*; the TPU-native engine is the MXU slice march in ``ops/slicer.py``
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp

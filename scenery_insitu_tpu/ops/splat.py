@@ -24,7 +24,7 @@ reference mixed conventions and needed a converter pass; see SURVEY.md §7
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 

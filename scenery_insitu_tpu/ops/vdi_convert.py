@@ -24,7 +24,7 @@ converter for interchange with reference-convention consumers:
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax.numpy as jnp
 import numpy as np

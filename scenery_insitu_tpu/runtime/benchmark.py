@@ -111,7 +111,7 @@ def record_flythrough(render: Callable[[Camera], object],
     """Render every camera of ``path``; save frame PNGs to ``out_dir`` and
     optionally feed a ``runtime.streaming.video_sink``. Returns the number
     of frames rendered."""
-    from scenery_insitu_tpu.utils.image import save_png, to_display
+    from scenery_insitu_tpu.utils.image import save_png
 
     os.makedirs(out_dir, exist_ok=True)
     for i, cam in enumerate(path):

@@ -6,7 +6,6 @@ VDIGenerator.comp:537 — one of the parity hazards SURVEY.md §7 flags)."""
 
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

@@ -268,6 +268,11 @@ def _orchestrate():
             time.sleep(min(10 * i, 30))   # backoff between attempts
         result, err = _run_child(platform, timeout_s)
         if result is not None:
+            if errors:
+                # a fallback number must carry WHY the better platforms
+                # failed (a CPU figure with no context reads as the
+                # framework's speed; with this it reads as an outage)
+                result["failed_attempts"] = errors
             print(json.dumps(result), flush=True)
             return
         errors.append(err)

@@ -167,3 +167,31 @@ def test_proxy_volume_same_view_roundtrip(fixture):
     ref = slicer.warp_to_camera(ref_int, axcam, spec, cam0, 64, 48)
     q = psnr(np.asarray(ref), np.asarray(out.image))
     assert q > 24.0, f"PSNR {q:.1f} dB"
+
+
+@pytest.mark.parametrize("gen_eye,new_eye,gen_axis", [
+    ((2.8, 0.2, 0.3), (0.1, 0.3, 2.7), 0),  # generate along x, view z
+    ((0.2, 2.8, 0.3), (2.7, 0.2, 0.3), 1),  # generate along y, view x
+])
+def test_cross_regime_other_generating_axes(gen_eye, new_eye, gen_axis):
+    """The proxy builder's (w, v, u) -> (z, y, x) arrangement branches for
+    x- and y-axis generating cameras (the module fixture only generates
+    along z)."""
+    from scenery_insitu_tpu.ops.vdi_novel import render_vdi_any
+
+    vol = procedural_volume(32, kind="blobs", seed=3)
+    tf = for_dataset("procedural")
+    cam0 = Camera.create(gen_eye, fov_y_deg=45.0, near=0.3, far=10.0)
+    spec = slicer.make_spec(cam0, vol.data.shape, F32)
+    assert spec.axis == gen_axis      # pins the transpose branch under test
+    vdi, meta, axcam = slicer.generate_vdi_mxu(
+        vol, tf, cam0, spec, VDIConfig(max_supersegments=8,
+                                       adaptive_iters=3))
+    cam1 = Camera.create(new_eye, fov_y_deg=45.0, near=0.3, far=10.0)
+    assert slicer.choose_axis(cam1)[0] != spec.axis
+    img = render_vdi_any(vdi, axcam, spec, cam1, 64, 48,
+                         num_slices=vol.data.shape[0])
+    ref = render_vdi(vdi, meta, cam1, 64, 48, steps=128)
+    assert np.isfinite(np.asarray(img)).all()
+    q = psnr(np.asarray(ref), np.asarray(img))
+    assert q > 24.0, f"PSNR {q:.1f} dB (gen {gen_eye} -> view {new_eye})"

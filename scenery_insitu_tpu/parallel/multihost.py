@@ -25,9 +25,11 @@ Smoke test (single machine, 2 processes — ≅ mpirun -np 2):
     python -m scenery_insitu_tpu.parallel.multihost --launch 2
 
 Each process pins 2 virtual CPU devices, initializes the coordination
-service, runs one distributed_vdi_step over the 4-device global mesh, and
-checks that the replicated output norm agrees across processes (printed
-as ``MULTIHOST_OK norm=...`` for the launcher and tests to compare).
+service, runs one distributed_vdi_step over the 4-device global mesh
+(``MULTIHOST_OK norm=...``), then the flagship temporal MXU chain —
+rank-sharded threshold seed + two carried-state frames —
+(``MULTIHOST_MXU_OK norm=...``); norms must agree across processes, and
+process 0 checks the compressed host gather (``MULTIHOST_GATHER_OK``).
 """
 
 from __future__ import annotations
@@ -169,6 +171,26 @@ def _worker(coordinator: str, nproc: int, pid: int) -> None:
     # replicated reduction: every process must report the same value
     norm = float(jax.jit(lambda c: jnp.linalg.norm(c))(vdi.color))
     print(f"MULTIHOST_OK pid={pid} norm={norm:.6f}", flush=True)
+
+    # flagship path across processes: MXU slice march with carried
+    # temporal threshold state (rank-sharded through the global mesh)
+    from scenery_insitu_tpu.config import SliceMarchConfig
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.parallel.pipeline import (
+        distributed_initial_threshold_mxu, distributed_vdi_step_mxu_temporal)
+
+    spec = slicer.make_spec(cam, (8 * n, grid_h, grid_w),
+                            SliceMarchConfig(matmul_dtype="f32"),
+                            multiple_of=n)
+    cfg_t = VDIConfig(max_supersegments=4, adaptive_mode="temporal")
+    comp = CompositeConfig(max_output_supersegments=6, adaptive_iters=2)
+    thr = distributed_initial_threshold_mxu(mesh, tf, spec, cfg_t)(
+        field, origin, spacing, cam)
+    step_t = distributed_vdi_step_mxu_temporal(mesh, tf, spec, cfg_t, comp)
+    for _ in range(2):
+        (vdi_t, _), thr = step_t(field, origin, spacing, cam, thr)
+    norm_t = float(jax.jit(lambda c: jnp.linalg.norm(c))(vdi_t.color))
+    print(f"MULTIHOST_MXU_OK pid={pid} norm={norm_t:.6f}", flush=True)
 
     gathered = gather_vdi_compressed(vdi)
     if pid == 0:

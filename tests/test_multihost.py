@@ -34,6 +34,10 @@ def test_two_process_smoke_matches_single_process():
     assert len(norms) == 2 and abs(norms[0] - norms[1]) < 1e-4
     gather = re.search(r"MULTIHOST_GATHER_OK .*norm=([0-9.]+)", out)
     assert gather, out
+    # the temporal MXU step must also agree across processes
+    mxu = [float(m) for m in re.findall(r"MULTIHOST_MXU_OK pid=\d+ "
+                                        r"norm=([0-9.]+)", out)]
+    assert len(mxu) == 2 and abs(mxu[0] - mxu[1]) < 1e-4, out
 
     # single-process reference: the identical configuration on this
     # process's virtual mesh (4 devices = 2 procs x 2 devices)

@@ -30,6 +30,13 @@ def main():
                     help="ZMQ bind address accepting camera steering "
                          "messages (e.g. tcp://*:6656; pair with "
                          "vdi_client.py --steer)")
+    ap.add_argument("--movie", default="",
+                    help="also write an .mp4 of the run (movie-writer "
+                         "sink, ≅ the reference's VideoEncoder file)")
+    ap.add_argument("--live-udp", type=int, default=0,
+                    help="also stream frames live over UDP on this port "
+                         "(≅ the reference's UDP:3337 video stream; view "
+                         "with runtime.streaming.VideoReceiver)")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", default="", help="checkpoint to resume from")
     ap.add_argument("--cpu", action="store_true",
@@ -57,10 +64,19 @@ def main():
         "slicer.engine=mxu", "vdi.adaptive_mode=temporal",
         "runtime.dataset=gray_scott")
     sinks = [png_sink(args.out)]
+    movie = None
     if args.publish:
         from scenery_insitu_tpu.runtime.streaming import (VDIPublisher,
                                                           stream_sink)
         sinks.append(stream_sink(VDIPublisher(args.publish)))
+    if args.movie:
+        from scenery_insitu_tpu.runtime.streaming import video_sink
+        movie = video_sink(args.movie)
+        sinks.append(movie)
+    if args.live_udp:
+        from scenery_insitu_tpu.runtime.streaming import (VideoStreamer,
+                                                          live_video_sink)
+        sinks.append(live_video_sink(VideoStreamer(port=args.live_udp)))
     sess = InSituSession(cfg, sinks=sinks)
     if args.steer_bind:
         from scenery_insitu_tpu.runtime.streaming import SteeringEndpoint
@@ -72,7 +88,11 @@ def main():
     if args.resume:
         load_session(sess, args.resume)
         print(f"resumed at frame {sess.frame_index}")
-    sess.run(args.frames)
+    try:
+        sess.run(args.frames)
+    finally:
+        if movie is not None:   # finalize the mp4 even on interrupt
+            movie.release()
     print(f"wrote {args.frames} frames to {args.out}/ "
           f"(engine={sess.engine}, mode={sess.mode})")
 

@@ -232,6 +232,7 @@ def _launch(nproc: int, devices_per_proc: int = 2) -> int:
                 os.path.abspath(__file__))))))
 
     norms = {}
+    mxu_norms = {}
     ok = True
     for pid, p in enumerate(procs):
         out, _ = p.communicate(timeout=600)
@@ -242,11 +243,18 @@ def _launch(nproc: int, devices_per_proc: int = 2) -> int:
         for line in text.splitlines():
             if line.startswith("MULTIHOST_OK"):
                 norms[pid] = float(line.rsplit("norm=", 1)[1])
-    if ok and len(set(round(v, 4) for v in norms.values())) == 1 \
-            and len(norms) == nproc:
-        print(f"LAUNCH_OK processes={nproc} norm={norms[0]:.6f}")
+            elif line.startswith("MULTIHOST_MXU_OK"):
+                mxu_norms[pid] = float(line.rsplit("norm=", 1)[1])
+
+    def agree(d):
+        return len(d) == nproc and len(set(round(v, 4)
+                                           for v in d.values())) == 1
+
+    if ok and agree(norms) and agree(mxu_norms):
+        print(f"LAUNCH_OK processes={nproc} norm={norms[0]:.6f} "
+              f"mxu_norm={mxu_norms[0]:.6f}")
         return 0
-    print("LAUNCH_FAILED", norms)
+    print("LAUNCH_FAILED", norms, mxu_norms)
     return 1
 
 

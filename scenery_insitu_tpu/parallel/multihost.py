@@ -235,7 +235,17 @@ def _launch(nproc: int, devices_per_proc: int = 2) -> int:
     mxu_norms = {}
     ok = True
     for pid, p in enumerate(procs):
-        out, _ = p.communicate(timeout=600)
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            # a wedged worker must still yield a parseable verdict and
+            # must not leave its siblings bound to the coordinator port
+            ok = False
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            print(f"LAUNCH_FAILED worker {pid} timed out")
+            return 1
         text = out.decode("utf-8", "replace")
         print(text)
         if p.returncode != 0:

@@ -1,0 +1,64 @@
+"""The driver runs `python bench.py` at every round boundary and parses
+ONE JSON line — round 1 lost its perf artifact to an unhandled backend
+crash, so the orchestration path is load-bearing. This runs the real
+script as a subprocess on the CPU platform with tiny knobs and checks
+the contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_one_parseable_json_line():
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": _ROOT,            # drops the axon site dir
+        "SITPU_BENCH_PLATFORMS": "cpu",
+        "SITPU_BENCH_GRID": "24",
+        "SITPU_BENCH_K": "4",
+        "SITPU_BENCH_FRAMES": "2",
+        "SITPU_BENCH_SIM_STEPS": "1",
+        "SITPU_BENCH_CHILD_TIMEOUT": "420",
+    })
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True, timeout=480)
+    assert p.returncode == 0, p.stderr[-800:]
+    lines = [l for l in p.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, p.stdout
+    d = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in d, d
+    assert d["value"] is not None and d["value"] > 0
+    assert d["unit"] == "frames/s"
+    assert d["config"]["platform"] == "cpu"
+    assert d["config"]["adaptive_mode"] == "temporal"   # bench default
+
+
+def test_bench_reports_failed_attempts_on_fallback(tmp_path):
+    """A platform whose child crashes must be recorded in the successful
+    fallback's JSON (the judge reads WHY a number is CPU)."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": _ROOT,
+        # the parent leaves JAX_PLATFORMS alone for non-cpu attempts (the
+        # conftest exports cpu into our env, which would make the bogus
+        # platform's child succeed); pin it so the "nope" child dies in
+        # backend init while the cpu child's own override still applies
+        "JAX_PLATFORMS": "nope",
+        "SITPU_BENCH_PLATFORMS": "nope,cpu",
+        "SITPU_BENCH_GRID": "24",
+        "SITPU_BENCH_K": "4",
+        "SITPU_BENCH_FRAMES": "1",
+        "SITPU_BENCH_SIM_STEPS": "1",
+        "SITPU_BENCH_CHILD_TIMEOUT": "420",
+    })
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True, timeout=900)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads([l for l in p.stdout.strip().splitlines()
+                    if l.startswith("{")][-1])
+    assert d["value"] is not None
+    assert any("nope" in e for e in d.get("failed_attempts", [])), d

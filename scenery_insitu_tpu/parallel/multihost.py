@@ -240,10 +240,14 @@ def _launch(nproc: int, devices_per_proc: int = 2) -> int:
         except subprocess.TimeoutExpired:
             # a wedged worker must still yield a parseable verdict and
             # must not leave its siblings bound to the coordinator port
-            ok = False
             for q in procs:
                 if q.poll() is None:
                     q.kill()
+            for q in procs:     # reap: SIGKILL delivery is asynchronous
+                try:
+                    q.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
             print(f"LAUNCH_FAILED worker {pid} timed out")
             return 1
         text = out.decode("utf-8", "replace")

@@ -94,3 +94,32 @@ def test_checkpoint_sink(tmp_path):
     # the dump must load back into a fresh same-config session
     c = InSituSession(_cfg(**{"vdi.adaptive_mode": "histogram"}))
     load_session(c, files[-1])
+
+
+def test_resume_bit_exact_across_regime_switches(tmp_path):
+    """Checkpoint taken mid-orbit with several march regimes' threshold
+    state in flight: the resumed run must reproduce the uninterrupted one
+    bit-exactly, including the regime tracker's drop/keep decisions."""
+    path = str(tmp_path / "r.npz")
+
+    def mk():
+        s = InSituSession(_cfg(**{"sim.grid": "[12,12,12]",
+                                  "mesh.num_devices": "2"}))
+        s.orbit_rate = 0.35      # ~18 frames per revolution
+        return s
+
+    a = mk()
+    ref = a.run(20)
+    assert len(a._mxu_thr) >= 2          # the orbit crossed regimes
+
+    b = mk()
+    b.run(12)
+    assert len(b._mxu_thr) >= 2   # the checkpoint itself is multi-regime
+    save_session(b, path)
+    c = mk()
+    load_session(c, path)
+    got = c.run(8)
+
+    assert got["frame"] == ref["frame"]
+    np.testing.assert_array_equal(ref["vdi_color"], got["vdi_color"])
+    np.testing.assert_array_equal(ref["vdi_depth"], got["vdi_depth"])

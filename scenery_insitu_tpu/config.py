@@ -98,6 +98,12 @@ class SliceMarchConfig:
     # zero alpha (≅ the reference's OctreeCells occupancy acceleration,
     # VDIGenerator.comp:232-254 — here consumed, per-frame, by the march).
     skip_empty: bool = True
+    # Supersegment-fold schedule for the VDI marches: "xla" = lax.scan with
+    # full-frame SegState (every push round-trips HBM); "pallas" = fused
+    # VMEM pixel-strip kernel (ops/pallas_march.py — state enters/leaves
+    # HBM once per CHUNK, ≅ the reference's single-kernel generation,
+    # VDIGenerator.comp + AccumulateVDI.comp); "auto" = pallas on TPU.
+    fold: str = "auto"
 
 
 @dataclass(frozen=True)

@@ -32,14 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from scenery_insitu_tpu.ops import supersegments as ss
-
-# f32 native tile: 8 sublanes x 128 lanes
-TILE_H = 8
-TILE_W = 128
-
-
-def _should_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from scenery_insitu_tpu.ops.pallas_util import TILE_H, TILE_W, should_interpret
 
 
 def _kernel(sc_ref, sd_ref, thr_ref, color_ref, depth_ref,
@@ -158,7 +151,7 @@ def resegment_sorted(sc: jnp.ndarray, sd: jnp.ndarray,
     """
     nk, _, h, w = sc.shape
     if interpret is None:
-        interpret = _should_interpret()
+        interpret = should_interpret()
     if threshold is None:
         threshold = jnp.zeros((h, w), jnp.float32)
 

@@ -1,0 +1,271 @@
+"""Pallas TPU kernels for the slice-march supersegment folds — the fused
+counterpart of the reference's single-kernel generation (VDIGenerator.comp:
+380-529 + AccumulateVDI.comp:69-98, where raycast sampling and the
+supersegment state machine live in ONE GPU kernel and the per-ray state
+never leaves registers).
+
+The XLA march (ops/slicer.slice_march + ops/supersegments.push) carries the
+full ``SegState`` — ~107 floats per pixel, dominated by ``out_color
+[K,4,H,W]`` — through a ``lax.scan``, and every per-slice ``push`` inside
+the scan body reads and rewrites those full-frame tensors through HBM.
+Profiling put that write fold at ~40% of generation and matmul MFU at 0.8%:
+the march is fold-bandwidth-bound, not MXU-bound.
+
+These kernels keep the resampling einsum in XLA (it IS the MXU work) and
+run the fold over VMEM-resident pixel tiles instead:
+
+- `fold_chunk`: feed one chunk of C depth-ordered slices through the
+  writer state machine (`ss.push`), one kernel launch per chunk. State
+  enters and leaves the kernel once per CHUNK instead of per slice, and
+  the C pushes in between touch only VMEM. Optionally counts true segment
+  starts in the same pass (the temporal controller's feedback signal —
+  `ss.push_count` shares the writer's prev-item stream, so the count is
+  free here, where the XLA path folds a separate CountState).
+- `count_multi_chunk`: the histogram counting march — evaluates every
+  candidate threshold simultaneously (`ss.init_count_multi` semantics)
+  on the VMEM tile; candidates are compile-time constants.
+
+Both kernels call the exact `ops.supersegments` fold functions the XLA
+path uses — one implementation of the semantics, two schedules — so
+tests/test_pallas_march.py asserts exact equality, chunk by chunk.
+
+State is packed into 7 arrays (bool → f32 flags, as in pallas_composite):
+``color [K,4,H,W], depth [K,2,H,W], seg [4,H,W], segse [2,H,W],
+prev [3,H,W], flags [2,H,W] (open_, prev_empty), k i32[H,W]``.
+``input_output_aliases`` pins each state input to its output so XLA can
+update in place.
+
+Tiling: (8, W) strips — 8 sublanes × the full row width, grid over H/8.
+W needn't be a multiple of 128: a strip is the whole (only) block of its
+row range, so Mosaic masks the lane padding and no HBM copy is spent on
+alignment. H must be a multiple of 8 (`slicer.make_spec` guarantees it).
+On CPU (tests, the virtual mesh) the kernels run in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from scenery_insitu_tpu.ops import supersegments as ss
+from scenery_insitu_tpu.ops.pallas_util import TILE_H, should_interpret
+
+# packed-state field count; see pack_state
+_STATE_FIELDS = 7
+
+
+# ------------------------------------------------------------- state packing
+
+
+def init_packed(k: int, height: int, width: int):
+    """Packed fold state ≅ ss.init_state(k, height, width)."""
+    return pack_state(ss.init_state(k, height, width))
+
+
+def pack_state(st: ss.SegState):
+    flags = jnp.stack([st.open_.astype(jnp.float32),
+                       st.prev_empty.astype(jnp.float32)])
+    return (st.out_color,
+            jnp.stack([st.out_start, st.out_end], axis=1),
+            st.seg_rgba,
+            jnp.stack([st.seg_start, st.seg_end]),
+            st.prev_rgb,
+            flags,
+            st.k)
+
+
+def unpack_state(packed) -> ss.SegState:
+    color, depth, seg, segse, prev, flags, k = packed
+    return ss.SegState(
+        out_color=color, out_start=depth[:, 0], out_end=depth[:, 1],
+        k=k, open_=flags[0] > 0.5, seg_rgba=seg,
+        seg_start=segse[0], seg_end=segse[1],
+        prev_rgb=prev, prev_empty=flags[1] > 0.5)
+
+
+# ------------------------------------------------------------ write(+count)
+
+
+def _fold_kernel(*refs, max_k: int, gap_eps: float, with_count: bool):
+    if with_count:
+        (rgba_ref, td_ref, thr_ref,
+         ci, di, si, ssei, pi, fi, ki, cnt_i,
+         co, do_, so, sseo, po, fo, ko, cnt_o) = refs
+    else:
+        (rgba_ref, td_ref, thr_ref,
+         ci, di, si, ssei, pi, fi, ki,
+         co, do_, so, sseo, po, fo, ko) = refs
+        cnt_i = cnt_o = None
+    nc = rgba_ref.shape[0]
+    thr = thr_ref[...]
+
+    # working state lives in the OUTPUT refs (VMEM blocks): seed from the
+    # inputs once, fold all C slices, leave the result in place. The
+    # fori_loop carries nothing — Mosaic cannot legalize a loop with a
+    # dozen carried vectors (see pallas_composite._kernel).
+    co[...] = ci[...]
+    do_[...] = di[...]
+    so[...] = si[...]
+    sseo[...] = ssei[...]
+    po[...] = pi[...]
+    fo[...] = fi[...]
+    ko[...] = ki[...]
+    if with_count:
+        cnt_o[...] = cnt_i[...]
+
+    def load() -> ss.SegState:
+        return ss.SegState(
+            out_color=co[...], out_start=do_[:, 0], out_end=do_[:, 1],
+            k=ko[...], open_=fo[0] > 0.5, seg_rgba=so[...],
+            seg_start=sseo[0], seg_end=sseo[1],
+            prev_rgb=po[...], prev_empty=fo[1] > 0.5)
+
+    def store(st: ss.SegState) -> None:
+        co[...] = st.out_color
+        do_[:, 0] = st.out_start
+        do_[:, 1] = st.out_end
+        so[...] = st.seg_rgba
+        sseo[0] = st.seg_start
+        sseo[1] = st.seg_end
+        po[...] = st.prev_rgb
+        fo[0] = st.open_.astype(jnp.float32)
+        fo[1] = st.prev_empty.astype(jnp.float32)
+        ko[...] = st.k
+
+    def body(i, _):
+        st = load()
+        if with_count:
+            # true (uncapped) segment starts — ss.push_count's predicate on
+            # the writer's own prev-item stream (identical tracking rules)
+            starts, _ = ss._start_mask(st.prev_rgb, st.prev_empty, None,
+                                       rgba_ref[i], thr, None, -1.0)
+            cnt_o[...] = cnt_o[...] + starts.astype(jnp.int32)
+        store(ss.push(st, max_k, thr, rgba_ref[i],
+                      td_ref[i, 0], td_ref[i, 1], gap_eps))
+        return 0
+
+    jax.lax.fori_loop(0, nc, body, 0)
+
+
+def fold_chunk(packed, rgba: jnp.ndarray, t0: jnp.ndarray, t1: jnp.ndarray,
+               threshold: jnp.ndarray, *, max_k: int,
+               count: Optional[jnp.ndarray] = None, gap_eps: float = -1.0,
+               interpret: Optional[bool] = None):
+    """Fold one chunk of slices through the writer machine on pixel strips.
+
+    packed: `pack_state` tuple ([K,…,H,W] / […,H,W]); rgba f32[C,4,H,W]
+    premultiplied; t0/t1 f32[C,H,W]; threshold f32[H,W] (or scalar).
+    ``count`` (i32[H,W], optional) additionally accumulates TRUE segment
+    starts at this threshold (the temporal controller's signal). Returns
+    the updated packed state (and count when given) — bit-identical to C
+    sequential ``ss.push``/``ss.push_count`` calls.
+    """
+    if interpret is None:
+        interpret = should_interpret()
+    color = packed[0]
+    kk, _, h, w = color.shape
+    c = rgba.shape[0]
+    if h % TILE_H:
+        raise ValueError(f"height {h} not a multiple of {TILE_H}")
+    threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
+    td = jnp.stack([t0, t1], axis=1)                       # [C, 2, H, W]
+    with_count = count is not None
+
+    grid = (h // TILE_H,)
+    row = lambda *lead: pl.BlockSpec(lead + (TILE_H, w),
+                                     lambda j: (0,) * len(lead) + (j, 0))
+    state_specs = [row(kk, 4), row(kk, 2), row(4), row(2), row(3), row(2),
+                   row()]
+    state_shapes = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in packed]
+    in_specs = [row(c, 4), row(c, 2), row()] + list(state_specs)
+    out_specs = list(state_specs)
+    out_shapes = list(state_shapes)
+    operands = [rgba, td, threshold, *packed]
+    # state input i+3 aliases output i (in-place update under jit)
+    aliases = {i + 3: i for i in range(_STATE_FIELDS)}
+    if with_count:
+        in_specs.append(row())
+        out_specs.append(row())
+        out_shapes.append(jax.ShapeDtypeStruct((h, w), jnp.int32))
+        operands.append(count)
+        aliases[3 + _STATE_FIELDS] = _STATE_FIELDS
+
+    kernel = functools.partial(_fold_kernel, max_k=max_k, gap_eps=gap_eps,
+                               with_count=with_count)
+    out = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shapes, input_output_aliases=aliases,
+        interpret=interpret,
+    )(*operands)
+    if with_count:
+        return tuple(out[:_STATE_FIELDS]), out[_STATE_FIELDS]
+    return tuple(out)
+
+
+# ------------------------------------------------------- histogram counting
+
+
+def _count_kernel(rgba_ref, tvec_ref, cnt_i, prev_i, fe_i,
+                  cnt_o, prev_o, fe_o):
+    nc = rgba_ref.shape[0]
+    thr = tvec_ref[...]                                    # [B, 1, 1]
+    cnt_o[...] = cnt_i[...]
+    prev_o[...] = prev_i[...]
+    fe_o[...] = fe_i[...]
+
+    def body(i, _):
+        rgba = rgba_ref[i]
+        starts, is_empty = ss._start_mask(prev_o[...], fe_o[...] > 0.5,
+                                          None, rgba, thr, None, -1.0)
+        cnt_o[...] = cnt_o[...] + starts.astype(jnp.int32)
+        prev_o[...] = jnp.where(is_empty[None], prev_o[...], rgba[:3])
+        fe_o[...] = is_empty.astype(jnp.float32)
+        return 0
+
+    jax.lax.fori_loop(0, nc, body, 0)
+
+
+def count_multi_chunk(carry, rgba: jnp.ndarray, tvec, *,
+                      interpret: Optional[bool] = None):
+    """One chunk of the all-candidates counting march (≅ feeding
+    `ss.init_count_multi` state through `ss.push_count` with
+    ``threshold=tvec[:,None,None]``, VMEM-tiled). ``carry`` is
+    ``(count i32[B,H,W], prev f32[3,H,W], prev_empty f32[H,W])``;
+    ``tvec`` is the B candidate thresholds (any array-like; a pallas
+    kernel cannot close over array constants, so they ride as a [B,1,1]
+    input).
+    """
+    if interpret is None:
+        interpret = should_interpret()
+    count, prev, fe = carry
+    b, h, w = count.shape
+    c = rgba.shape[0]
+    if h % TILE_H:
+        raise ValueError(f"height {h} not a multiple of {TILE_H}")
+    tvec3 = jnp.asarray(tvec, jnp.float32).reshape(b, 1, 1)
+
+    row = lambda *lead: pl.BlockSpec(lead + (TILE_H, w),
+                                     lambda j: (0,) * len(lead) + (j, 0))
+    out = pl.pallas_call(
+        _count_kernel, grid=(h // TILE_H,),
+        in_specs=[row(c, 4),
+                  pl.BlockSpec((b, 1, 1), lambda j: (0, 0, 0)),
+                  row(b), row(3), row()],
+        out_specs=[row(b), row(3), row()],
+        out_shape=[jax.ShapeDtypeStruct((b, h, w), jnp.int32),
+                   jax.ShapeDtypeStruct((3, h, w), jnp.float32),
+                   jax.ShapeDtypeStruct((h, w), jnp.float32)],
+        input_output_aliases={2: 0, 3: 1, 4: 2},
+        interpret=interpret,
+    )(rgba, tvec3, count, prev, fe)
+    return tuple(out)
+
+
+def init_count_multi_packed(bins: int, height: int, width: int):
+    return (jnp.zeros((bins, height, width), jnp.int32),
+            jnp.zeros((3, height, width), jnp.float32),
+            jnp.ones((height, width), jnp.float32))

@@ -58,6 +58,7 @@ from scenery_insitu_tpu.core.camera import Camera, frustum, look_at
 from scenery_insitu_tpu.core.transfer import TransferFunction
 from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
 from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.ops import pallas_march as pm
 from scenery_insitu_tpu.ops import supersegments as ss
 from scenery_insitu_tpu.ops.raycast import RaycastOutput, nominal_step
 from scenery_insitu_tpu.ops.sampling import adjust_opacity
@@ -82,6 +83,7 @@ class AxisSpec:
     matmul_dtype: str = "bf16"   # resampling matmul operand dtype
     s_floor: float = 1e-3     # min depth ratio: slices closer are dropped
     skip_empty: bool = True   # chunk_occupancy-based empty-space skipping
+    fold: str = "xla"         # supersegment-fold schedule: "xla" | "pallas"
 
     @property
     def u_axis(self) -> int:
@@ -131,10 +133,18 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     dtype = cfg.matmul_dtype
     if dtype == "bf16" and jax.default_backend() != "tpu":
         dtype = "f32"
+    fold = cfg.fold
+    if fold == "auto":
+        # interpret-mode pallas is far slower than the XLA scan on CPU
+        fold = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if fold not in ("xla", "pallas"):
+        raise ValueError(f"unknown fold schedule {fold!r} "
+                         "(expected 'auto', 'xla' or 'pallas')")
     return AxisSpec(axis=axis, sign=sign,
                     ni=rnd(dims_xyz[u_axis]), nj=rnd(dims_xyz[v_axis]),
                     chunk=cfg.chunk, matmul_dtype=dtype,
-                    s_floor=cfg.s_floor, skip_empty=cfg.skip_empty)
+                    s_floor=cfg.s_floor, skip_empty=cfg.skip_empty,
+                    fold=fold)
 
 
 class AxisCamera(NamedTuple):
@@ -639,8 +649,11 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
             "call generate_vdi_mxu_temporal(..., threshold=...) instead "
             "(seed the state with initial_threshold())")
     if cfg.adaptive and cfg.adaptive_mode == "histogram":
-        threshold = _histogram_threshold(march, cfg, k, nj, ni)
+        threshold = _histogram_threshold(march, cfg, k, nj, ni, spec.fold)
     elif cfg.adaptive:
+        # "search" mode: adaptive_iters counting marches (XLA fold — the
+        # default modes are histogram/temporal; search stays the portable
+        # reference schedule)
         def count_fn(thr):
             def consume(st, rgba, t0, t1):
                 for i in range(rgba.shape[0]):
@@ -652,13 +665,20 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     else:
         threshold = jnp.full((nj, ni), cfg.threshold, jnp.float32)
 
-    def consume(st, rgba, t0, t1):
-        for i in range(rgba.shape[0]):
-            st = ss.push(st, k, threshold, rgba[i], t0[i], t1[i])
-        return st
+    if spec.fold == "pallas":
+        def consume(packed, rgba, t0, t1):
+            return pm.fold_chunk(packed, rgba, t0, t1, threshold, max_k=k)
 
-    state = march(consume, ss.init_state(k, nj, ni))
-    color, depth = ss.finalize(state)
+        packed = march(consume, pm.init_packed(k, nj, ni))
+        color, depth = ss.finalize(pm.unpack_state(packed))
+    else:
+        def consume(st, rgba, t0, t1):
+            for i in range(rgba.shape[0]):
+                st = ss.push(st, k, threshold, rgba[i], t0[i], t1[i])
+            return st
+
+        state = march(consume, ss.init_state(k, nj, ni))
+        color, depth = ss.finalize(state)
 
     meta = _vdi_meta(vol, axcam, ni, nj, frame_index)
     return VDI(color, depth), meta, axcam
@@ -678,18 +698,25 @@ def _vdi_meta(vol: Volume, axcam: AxisCamera, ni: int, nj: int,
                               nw=nominal_step(vol), index=frame_index)
 
 
-def _histogram_threshold(march, cfg: VDIConfig, k: int, nj: int, ni: int
-                         ) -> jnp.ndarray:
+def _histogram_threshold(march, cfg: VDIConfig, k: int, nj: int, ni: int,
+                         fold: str = "xla") -> jnp.ndarray:
     """One counting march for ALL candidate thresholds at once."""
     tvec = ss.threshold_candidates(cfg.histogram_bins, cfg.thr_max)
 
-    def consume_multi(st, rgba, t0, t1):
-        for i in range(rgba.shape[0]):
-            st = ss.push_count(st, tvec[:, None, None], rgba[i])
-        return st
+    if fold == "pallas":
+        def consume_multi(carry, rgba, t0, t1):
+            return pm.count_multi_chunk(carry, rgba, tvec)
 
-    counts = march(consume_multi,
-                   ss.init_count_multi(cfg.histogram_bins, nj, ni)).count
+        counts = march(consume_multi, pm.init_count_multi_packed(
+            cfg.histogram_bins, nj, ni))[0]
+    else:
+        def consume_multi(st, rgba, t0, t1):
+            for i in range(rgba.shape[0]):
+                st = ss.push_count(st, tvec[:, None, None], rgba[i])
+            return st
+
+        counts = march(consume_multi,
+                       ss.init_count_multi(cfg.histogram_bins, nj, ni)).count
     return ss.pick_threshold(counts, tvec, k)
 
 
@@ -710,7 +737,7 @@ def initial_threshold(vol: Volume, tf: TransferFunction, cam: Camera,
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
         occupancy=occ)
     thr = _histogram_threshold(march, cfg, cfg.max_supersegments,
-                               spec.nj, spec.ni)
+                               spec.nj, spec.ni, spec.fold)
     return ss.init_threshold_state(thr, cfg.thr_min, cfg.thr_max)
 
 
@@ -746,19 +773,35 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
     occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
 
-    def consume(carry, rgba, t0, t1):
-        st, cst = carry
-        for i in range(rgba.shape[0]):
-            st = ss.push(st, k, thr, rgba[i], t0[i], t1[i])
-            cst = ss.push_count(cst, thr, rgba[i])
-        return st, cst
+    if spec.fold == "pallas":
+        # fused write+count: ONE kernel per chunk, the count rides the
+        # writer's own prev-item stream (≅ the reference's single-kernel
+        # generate+accumulate, VDIGenerator.comp + AccumulateVDI.comp)
+        def consume(carry, rgba, t0, t1):
+            packed, count = carry
+            return pm.fold_chunk(packed, rgba, t0, t1, thr, max_k=k,
+                                 count=count)
 
-    state, cstate = slice_march(
-        vol, tf, axcam, spec, consume,
-        (ss.init_state(k, nj, ni), ss.init_count(nj, ni)),
-        u_bounds, v_bounds, occupancy=occ)
-    color, depth = ss.finalize(state)
-    next_thr = ss.update_threshold(threshold, cstate.count, k,
+        packed, count = slice_march(
+            vol, tf, axcam, spec, consume,
+            (pm.init_packed(k, nj, ni), jnp.zeros((nj, ni), jnp.int32)),
+            u_bounds, v_bounds, occupancy=occ)
+        color, depth = ss.finalize(pm.unpack_state(packed))
+    else:
+        def consume(carry, rgba, t0, t1):
+            st, cst = carry
+            for i in range(rgba.shape[0]):
+                st = ss.push(st, k, thr, rgba[i], t0[i], t1[i])
+                cst = ss.push_count(cst, thr, rgba[i])
+            return st, cst
+
+        state, cstate = slice_march(
+            vol, tf, axcam, spec, consume,
+            (ss.init_state(k, nj, ni), ss.init_count(nj, ni)),
+            u_bounds, v_bounds, occupancy=occ)
+        color, depth = ss.finalize(state)
+        count = cstate.count
+    next_thr = ss.update_threshold(threshold, count, k,
                                    cfg.adaptive_delta, cfg.thr_min,
                                    cfg.thr_max, cfg.temporal_track)
     meta = _vdi_meta(vol, axcam, ni, nj, frame_index)

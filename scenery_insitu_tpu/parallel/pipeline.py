@@ -301,7 +301,8 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
                                 comp_cfg: Optional[CompositeConfig] = None,
                                 radius: float = 0.02, stamp: int = 5,
                                 colormap: str = "jet",
-                                axis_name: Optional[str] = None):
+                                axis_name: Optional[str] = None,
+                                temporal: bool = False):
     """Distributed hybrid volume+particle frame (BASELINE.md Config 5):
     z-sharded volume through the sort-last MXU VDI chain, N-sharded
     tracers through the sort-first splat chain (per-rank z-buffer,
@@ -314,6 +315,13 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
     tracer_world f32[N,3] (N-sharded), tracer_vel f32[N,3] (same), cam)
     -> (image f32[4, Nj, Ni] W-sharded on the virtual grid, meta)``.
     Warp to the display camera with ops.slicer.warp_to_camera.
+
+    ``temporal=True`` threads carried per-rank threshold state through the
+    VDI pass exactly like `distributed_vdi_step_mxu_temporal` (seed with
+    `distributed_initial_threshold_mxu`): the signature gains a trailing
+    ``thr`` argument and the return becomes ``((image, meta), thr')`` —
+    the hybrid frame then pays ONE march/frame like the plain VDI path
+    (the steady-state economy of DistributedVolumes.kt:683-933).
     """
     from scenery_insitu_tpu.ops import slicer
     from scenery_insitu_tpu.ops.hybrid import composite_vdi_with_particles
@@ -328,10 +336,10 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
 
-    def step(local_data, origin, spacing, tr_pos, tr_vel, cam: Camera):
-        vdi, meta, axcam, _ = _mxu_rank_generate(local_data, origin,
-                                                 spacing, cam, slicer,
-                                                 spec, tf, vdi_cfg, axis, n)
+    def body(local_data, origin, spacing, tr_pos, tr_vel, cam, thr):
+        vdi, meta, axcam, thr2 = _mxu_rank_generate(
+            local_data, origin, spacing, cam, slicer, spec, tf, vdi_cfg,
+            axis, n, threshold=thr)
         colors = _exchange_columns(vdi.color, n, axis)
         depths = _exchange_columns(vdi.depth, n, axis)
         comp = composite_vdis(colors, depths, comp_cfg)    # [Ko,·,Nj,Ni/n]
@@ -347,14 +355,83 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
         img_b = jax.lax.dynamic_slice_in_dim(sp.image, r * wb, wb, axis=2)
         dep_b = jax.lax.dynamic_slice_in_dim(sp.depth, r * wb, wb, axis=1)
         hyb = composite_vdi_with_particles(comp, SplatOutput(img_b, dep_b))
-        return hyb, meta
+        return hyb, meta, thr2
 
     from scenery_insitu_tpu.core.vdi import VDIMetadata
     out_meta = VDIMetadata(*(P() for _ in VDIMetadata._fields))
+    in_base = (P(axis, None, None), P(), P(), P(axis, None), P(axis, None),
+               P())
+
+    if temporal:
+        thr_spec = _thr_state_spec(axis)
+
+        def step(local_data, origin, spacing, tr_pos, tr_vel, cam: Camera,
+                 thr):
+            img, meta, thr2 = body(local_data, origin, spacing, tr_pos,
+                                   tr_vel, cam, thr)
+            return (img, meta), thr2
+
+        f = shard_map(step, mesh=mesh, in_specs=in_base + (thr_spec,),
+                      out_specs=((P(None, None, axis), out_meta), thr_spec),
+                      check_vma=False)
+    else:
+        def step(local_data, origin, spacing, tr_pos, tr_vel, cam: Camera):
+            img, meta, _ = body(local_data, origin, spacing, tr_pos,
+                                tr_vel, cam, None)
+            return img, meta
+
+        f = shard_map(step, mesh=mesh, in_specs=in_base,
+                      out_specs=(P(None, None, axis), out_meta),
+                      check_vma=False)
+    return jax.jit(f)
+
+
+def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
+                               spec, cfg: Optional[RenderConfig] = None,
+                               axis_name: Optional[str] = None):
+    """Distributed plain-image rendering on the MXU slice-march engine —
+    the TPU-fast counterpart of `distributed_plain_step` (the reference's
+    non-VDI mode, VolumeRaycaster.comp:94-161 composited by
+    PlainImageCompositor.comp; mode switch DistributedVolumeRenderer.kt:
+    175-189). Per rank: `render_slices` on its z-slab (banded-matmul
+    resampling, no gathers), then the same sort-last column all_to_all +
+    nearest-first `composite_plain` as the gather path.
+
+    Returns ``f(vol_data f32[D,H,W] (z-sharded), origin, spacing, cam) ->
+    (image f32[4, Nj, Ni] W-sharded on the virtual grid, axcam)``. The
+    intermediate image is background-free; warp to the display camera
+    (which blends the background exactly once) with
+    ``slicer.warp_to_camera(image, axcam, spec, cam, width, height,
+    background)``. ``axcam`` is replicated (every rank derives it from the
+    shared global box), so the warp runs on the gathered global image.
+    """
+    from scenery_insitu_tpu.ops import slicer
+
+    cfg = cfg or RenderConfig()
+    axis = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    if spec.ni % n:
+        raise ValueError(f"intermediate width {spec.ni} not divisible by "
+                         f"mesh size {n}")
+
+    def step(local_data, origin, spacing, cam: Camera):
+        vol, gmax, v_bounds, _ = _rank_slab(local_data, origin, spacing,
+                                            spec, axis, n)
+        axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
+                                        box_max=gmax)
+        out = slicer.render_slices(vol, tf, axcam, spec,
+                                   cfg.early_exit_alpha, v_bounds=v_bounds,
+                                   step_scale=cfg.step_scale)
+        images = _exchange_columns(out.image, n, axis)     # [n, 4, Nj, Ni/n]
+        depths = _exchange_columns(out.depth, n, axis)     # [n, Nj, Ni/n]
+        # rank partials stay background-free; the display warp blends it
+        return composite_plain(images, depths, (0.0, 0.0, 0.0, 0.0)), axcam
+
+    from scenery_insitu_tpu.ops.slicer import AxisCamera
+    out_axcam = AxisCamera(*(P() for _ in AxisCamera._fields))
     f = shard_map(step, mesh=mesh,
-                  in_specs=(P(axis, None, None), P(), P(),
-                            P(axis, None), P(axis, None), P()),
-                  out_specs=(P(None, None, axis), out_meta),
+                  in_specs=(P(axis, None, None), P(), P(), P()),
+                  out_specs=(P(None, None, axis), out_axcam),
                   check_vma=False)
     return jax.jit(f)
 

@@ -77,14 +77,16 @@ def save_session(sess: "InSituSession", path: str) -> None:
         "frame_index": sess.frame_index,
         "orbit_rate": float(sess.orbit_rate),
         "thr_regimes": sorted(sess._mxu_thr.keys()),
-        "last_regime": getattr(sess, "_last_regime", None),
+        "last_regime": getattr(sess, "_last_regime_key", None),
     }
     arrays = {f"sim/{k}": np.asarray(v)
               for k, v in _sim_arrays(sess.sim).items()}
     for name, val in zip(_CAMERA_FIELDS, sess.camera):
         arrays[f"camera/{name}"] = np.asarray(val)
     for regime, thr in sess._mxu_thr.items():
-        tag = f"thr/{regime[0]}_{regime[1]}"
+        # join EVERY key part: hybrid-mode keys are ('hybrid', axis, sign)
+        # and both signs of an axis must keep distinct tags
+        tag = "thr/" + "_".join(str(p) for p in regime)
         for field in ThresholdState._fields:
             arrays[f"{tag}/{field}"] = np.asarray(getattr(thr, field))
     with open(path, "wb") as f:       # stream; no in-memory zip copy
@@ -142,7 +144,7 @@ def load_session(sess: "InSituSession", path: str) -> None:
         sess._mxu_thr = {}
         for regime in header.get("thr_regimes", []):
             regime = tuple(regime)
-            tag = f"thr/{regime[0]}_{regime[1]}"
+            tag = "thr/" + "_".join(str(p) for p in regime)
             state = ThresholdState(
                 *(jnp.asarray(z[f"{tag}/{f}"])
                   for f in ThresholdState._fields))
@@ -153,24 +155,28 @@ def load_session(sess: "InSituSession", path: str) -> None:
                     f"{tuple(state.thr.shape)}, session expects {expect} "
                     "— same slicer/mesh config required")
             sess._mxu_thr[regime] = state
-        # restore the regime tracker VERBATIM: _mxu_step drops the entered
-        # regime's carried state on a regime CHANGE, and the resumed run
-        # must make the same drop/keep decisions as the uninterrupted one
+        # restore the regime tracker VERBATIM: _enter_regime drops the
+        # entered regime's carried state on a regime CHANGE, and the
+        # resumed run must make the same drop/keep decisions as the
+        # uninterrupted one
         last = header.get("last_regime")
         if last is not None:
-            sess._last_regime = tuple(last)
-        elif hasattr(sess, "_last_regime"):
-            del sess._last_regime
+            sess._last_regime_key = tuple(last)
+        elif hasattr(sess, "_last_regime_key"):
+            del sess._last_regime_key
 
 
 def _thr_shape(sess, regime):
     """Expected [n*nj, ni] of a regime's rank-stacked threshold maps under
-    this session's config (None for sessions without the mxu VDI path)."""
-    if sess.mode != "vdi" or sess.engine != "mxu":
+    this session's config (None for sessions without an mxu VDI pass).
+    Hybrid-mode keys are ('hybrid', axis, sign); vdi keys (axis, sign)."""
+    if sess.engine != "mxu" or sess.mode not in ("vdi", "hybrid"):
         return None
+    axis_sign = tuple(regime[1:]) if regime and regime[0] == "hybrid" \
+        else tuple(regime)
     n = sess.mesh.shape[sess.cfg.mesh.axis_name]
     spec = sess._slicer.make_spec(sess.camera, sess.sim.field.shape,
-                                  sess.cfg.slicer, axis_sign=tuple(regime),
+                                  sess.cfg.slicer, axis_sign=axis_sign,
                                   multiple_of=n)
     return (n * spec.nj, spec.ni)
 

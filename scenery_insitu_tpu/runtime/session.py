@@ -200,11 +200,9 @@ class InSituSession:
         r = self.cfg.render
         from scenery_insitu_tpu.ops import slicer as _slicer
         self._slicer = _slicer
-        # engine selection: the MXU slice march is implemented for the VDI
-        # pipeline; plain-image mode always uses the gather path
         self.engine = _slicer.resolve_engine(self.cfg.slicer.engine)
-        self._mxu_steps = {}   # (axis, sign) -> jitted distributed step
-        self._mxu_thr = {}     # (axis, sign) -> temporal threshold state
+        self._mxu_steps = {}   # regime key -> jitted distributed step
+        self._mxu_thr = {}     # regime key -> temporal threshold state
         self.mode = "vdi"
         if isinstance(self.sim, ParticleSimAdapter):
             # sort-first sphere rendering (≅ InVisRenderer + Head)
@@ -227,21 +225,28 @@ class InSituSession:
             self._step = distributed_vdi_step(
                 self.mesh, self.tf, r.width, r.height,
                 self.cfg.vdi, self.cfg.composite, max_steps=r.max_steps)
+        elif self.engine == "mxu":
+            # TPU plain mode: slice march + column exchange + nearest-first
+            # composite on the intermediate grid, homography-warped to the
+            # display camera per frame (≅ DistributedVolumeRenderer.kt:
+            # 175-189's plain pipeline, re-scheduled for the MXU)
+            self.mode = "plain"
+            self._step = None
         else:
-            self.engine = "gather"
             self.mode = "plain"
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
-                          and self.mode == "vdi" and self.engine == "mxu")
+                          and self.mode in ("vdi", "hybrid")
+                          and self.engine == "mxu")
         # particle/plain modes never consult cfg.vdi — only reject the
-        # modes that would hit the slicer's temporal-needs-state error at
-        # trace time (gather VDI generation, hybrid's VDI pass)
+        # mode that would hit the slicer's temporal-needs-state error at
+        # trace time (gather VDI generation)
         if (self.cfg.vdi.adaptive
                 and self.cfg.vdi.adaptive_mode == "temporal"
-                and not self._temporal and self.mode in ("vdi", "hybrid")):
+                and not self._temporal and self.mode == "vdi"):
             raise ValueError(
                 "adaptive_mode='temporal' is carried threshold state of "
                 "the MXU VDI pipeline — this session resolved to mode="
@@ -287,6 +292,9 @@ class InSituSession:
                     out = self._step(field, self._origin, self._spacing,
                                      self.camera)
                     meta = self.frame_metadata(self.frame_index)
+                elif self.mode == "plain":
+                    out = self._plain_mxu_dispatch(field)
+                    meta = self.frame_metadata(self.frame_index)
                 else:
                     out, meta = self._mxu_step()(field, self._origin,
                                                  self._spacing, self.camera)
@@ -294,6 +302,12 @@ class InSituSession:
         # metadata snapshot BEFORE the camera advances (fetch is pipelined
         # one frame behind, so it must not see the next frame's pose)
         self._pending_meta[self.frame_index] = meta
+        # bound the dict: the fetch runs at most one frame behind, so any
+        # older entry is unreachable — without this, a headless
+        # run(fetch=False) loop (which never pops) grows it forever
+        for k in [k for k in self._pending_meta
+                  if k < self.frame_index - 1]:
+            del self._pending_meta[k]
         advance_camera_and_index(self)
         return out
 
@@ -344,17 +358,31 @@ class InSituSession:
                 s(index, payload)
         return payload
 
+    def _enter_regime(self, key) -> None:
+        """Regime switch: drop the entered regime's carried threshold so it
+        re-seeds — state frozen many frames ago (while the camera was in
+        another regime, with the sim evolving) would take the controller
+        several overflow-degraded frames to walk back."""
+        if key != getattr(self, "_last_regime_key", key):
+            self._mxu_thr.pop(key, None)
+        self._last_regime_key = key
+
     def _hybrid_dispatch(self):
         """Dispatch one distributed hybrid frame: volume VDI + tracers,
-        merged on the virtual grid, warped to the display camera."""
+        merged on the virtual grid, warped to the display camera. In
+        temporal mode the VDI pass carries per-regime threshold state
+        (seeded on first use) exactly like the plain VDI pipeline."""
         from scenery_insitu_tpu.core.volume import Volume
         from scenery_insitu_tpu.parallel.particles import shard_particles
         from scenery_insitu_tpu.parallel.pipeline import (
-            distributed_hybrid_step_mxu)
+            distributed_hybrid_step_mxu, distributed_initial_threshold_mxu)
         from scenery_insitu_tpu.sim import vortex as _vx
 
         regime = self._slicer.choose_axis(self.camera)
-        entry = self._mxu_steps.get(("hybrid",) + regime)
+        key = ("hybrid",) + regime
+        if self._temporal:
+            self._enter_regime(key)
+        entry = self._mxu_steps.get(key)
         if entry is None:
             n = self.mesh.shape[self.cfg.mesh.axis_name]
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
@@ -363,7 +391,10 @@ class InSituSession:
             step = distributed_hybrid_step_mxu(
                 self.mesh, self.tf, spec, self.cfg.vdi, self.cfg.composite,
                 radius=self.cfg.sim.particle_radius * float(self._spacing[0]),
-                stamp=5)
+                stamp=5, temporal=self._temporal)
+            seed = (distributed_initial_threshold_mxu(
+                        self.mesh, self.tf, spec, self.cfg.vdi)
+                    if self._temporal else None)
             r = self.cfg.render
             slicer = self._slicer
 
@@ -374,17 +405,57 @@ class InSituSession:
                 return slicer.warp_to_camera(img, axcam, spec, cam,
                                              r.width, r.height, r.background)
 
-            entry = (step, warp)
-            self._mxu_steps[("hybrid",) + regime] = entry
-        step, warp = entry
+            entry = (step, seed, warp)
+            self._mxu_steps[key] = entry
+        step, seed, warp = entry
         field = self.sim.field
         vel = _vx.tracer_velocities(self.sim.flow.u, self.sim.tracers)
         world = _vx.tracers_to_world(self.sim.tracers, self._origin,
                                      self._spacing)
-        img, meta = step(shard_volume(field, self.mesh), self._origin,
-                         self._spacing, shard_particles(world, self.mesh),
-                         shard_particles(vel, self.mesh), self.camera)
+        sfield = shard_volume(field, self.mesh)
+        args = (sfield, self._origin, self._spacing,
+                shard_particles(world, self.mesh),
+                shard_particles(vel, self.mesh), self.camera)
+        if self._temporal:
+            thr = self._mxu_thr.get(key)
+            if thr is None:
+                thr = seed(sfield, self._origin, self._spacing, self.camera)
+            (img, meta), self._mxu_thr[key] = step(*args, thr)
+        else:
+            img, meta = step(*args)
         return warp(img, field, self.camera), meta
+
+    def _plain_mxu_dispatch(self, field):
+        """Dispatch one distributed plain-image frame on the slice-march
+        engine: per-rank `render_slices` + column all_to_all + nearest-
+        first composite (one SPMD program per march regime), then the
+        homography warp to the display camera."""
+        from scenery_insitu_tpu.parallel.pipeline import (
+            distributed_plain_step_mxu)
+
+        regime = self._slicer.choose_axis(self.camera)
+        key = ("plain",) + regime
+        entry = self._mxu_steps.get(key)
+        if entry is None:
+            n = self.mesh.shape[self.cfg.mesh.axis_name]
+            spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
+                                          self.cfg.slicer, axis_sign=regime,
+                                          multiple_of=n)
+            step = distributed_plain_step_mxu(self.mesh, self.tf, spec,
+                                              self.cfg.render)
+            r = self.cfg.render
+            slicer = self._slicer
+
+            @jax.jit
+            def warp(img, axcam, cam):
+                return slicer.warp_to_camera(img, axcam, spec, cam,
+                                             r.width, r.height, r.background)
+
+            entry = (step, warp)
+            self._mxu_steps[key] = entry
+        step, warp = entry
+        img, axcam = step(field, self._origin, self._spacing, self.camera)
+        return warp(img, axcam, self.camera)
 
     def _mxu_step(self):
         """Jitted MXU distributed step for the camera's current march
@@ -397,13 +468,8 @@ class InSituSession:
             distributed_vdi_step_mxu_temporal)
 
         regime = self._slicer.choose_axis(self.camera)
-        # regime switch: drop the entered regime's carried threshold so it
-        # re-seeds — state frozen many frames ago (while the camera was in
-        # another regime, with the sim evolving) would take the controller
-        # several overflow-degraded frames to walk back
-        if regime != getattr(self, "_last_regime", regime):
-            self._mxu_thr.pop(regime, None)
-        self._last_regime = regime
+        if self._temporal:
+            self._enter_regime(regime)
         step = self._mxu_steps.get(regime)
         if step is None:
             n = self.mesh.shape[self.cfg.mesh.axis_name]

@@ -118,8 +118,48 @@ def test_resume_bit_exact_across_regime_switches(tmp_path):
     save_session(b, path)
     c = mk()
     load_session(c, path)
+    # the drop/keep tracker must survive the round trip — without it the
+    # first post-resume frame makes a different drop decision than the
+    # uninterrupted run whenever the boundary lands on a regime switch
+    assert c._last_regime_key == b._last_regime_key
     got = c.run(8)
 
     assert got["frame"] == ref["frame"]
     np.testing.assert_array_equal(ref["vdi_color"], got["vdi_color"])
     np.testing.assert_array_equal(ref["vdi_depth"], got["vdi_depth"])
+
+
+def test_hybrid_temporal_checkpoint_roundtrip(tmp_path):
+    """Hybrid-mode temporal keys are ('hybrid', axis, sign) 3-tuples: both
+    signs of an axis must checkpoint under DISTINCT tags and restore
+    without cross-contamination."""
+    import jax.numpy as jnp
+
+    from scenery_insitu_tpu.ops.supersegments import ThresholdState
+
+    path = str(tmp_path / "h.npz")
+    cfg = _cfg(**{"sim.kind": "hybrid", "sim.num_particles": "32",
+                  "sim.particle_radius": "0.8",
+                  "sim.grid": "[12,12,12]", "mesh.num_devices": "2"})
+    a = InSituSession(cfg)
+    assert a._temporal
+    a.run(2)
+    (key,) = list(a._mxu_thr)
+    assert key[0] == "hybrid" and len(key) == 3
+    # fabricate the opposite-sign regime with distinct values: a tag
+    # collision would make one of the two restore as the other
+    other = (key[0], key[1], -key[2])
+    a._mxu_thr[other] = ThresholdState(
+        *(jnp.asarray(x) + 0.125 for x in a._mxu_thr[key]))
+    save_session(a, path)
+
+    b = InSituSession(cfg)
+    b.run(2)
+    load_session(b, path)
+    assert set(b._mxu_thr) == {key, other}
+    np.testing.assert_array_equal(np.asarray(a._mxu_thr[key].thr),
+                                  np.asarray(b._mxu_thr[key].thr))
+    np.testing.assert_array_equal(np.asarray(a._mxu_thr[other].thr),
+                                  np.asarray(b._mxu_thr[other].thr))
+    assert not np.array_equal(np.asarray(b._mxu_thr[key].thr),
+                              np.asarray(b._mxu_thr[other].thr))

@@ -9,7 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
+from scenery_insitu_tpu.config import (CompositeConfig, SliceMarchConfig,
+                                       VDIConfig)
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
 from scenery_insitu_tpu.core.vdi import VDI, render_vdi_same_view
@@ -132,3 +133,53 @@ def test_distributed_hybrid_matches_single_device():
     assert got.shape == want.shape
     p = psnr(got, want)
     assert p > 35.0, f"distributed hybrid diverges: PSNR {p:.1f} dB"
+
+
+def test_distributed_hybrid_temporal_matches_untracked():
+    """Hybrid step with carried temporal thresholds (one march/frame)
+    converges to the same image as the per-frame histogram hybrid step."""
+    from scenery_insitu_tpu.core.volume import Volume
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.parallel.particles import shard_particles
+    from scenery_insitu_tpu.parallel.pipeline import (
+        distributed_hybrid_step_mxu, distributed_initial_threshold_mxu,
+        shard_volume)
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.sim import vortex
+    from scenery_insitu_tpu.utils.image import psnr
+
+    n = 4
+    mesh = make_mesh(n)
+    grid = (16, 16, 16)
+    flow = vortex.VortexFlow.init_ring(grid)
+    flow = vortex.multi_step(flow, 2)
+    vol = Volume.centered(flow.field, extent=2.0)
+    pos = vortex.seed_tracers(grid, 64, seed=3)
+    vel = vortex.tracer_velocities(flow.u, pos)
+    world = vol.origin + pos * vol.spacing
+
+    tf = for_dataset("rotstrat")
+    cam = Camera.create((0.0, 0.4, 2.8), fov_y_deg=50.0, near=0.5, far=20.0)
+    spec = slicer.make_spec(cam, grid, SliceMarchConfig(matmul_dtype="f32"),
+                            multiple_of=n)
+    comp = CompositeConfig(max_output_supersegments=6, adaptive_iters=2)
+    data = shard_volume(vol.data, mesh)
+    wsh = shard_particles(world, mesh)
+    vsh = shard_particles(vel, mesh)
+
+    cfg_h = VDIConfig(max_supersegments=4, adaptive_mode="histogram")
+    img_h, _ = distributed_hybrid_step_mxu(
+        mesh, tf, spec, cfg_h, comp, radius=0.05, stamp=3)(
+        data, vol.origin, vol.spacing, wsh, vsh, cam)
+
+    cfg_t = VDIConfig(max_supersegments=4, adaptive_mode="temporal")
+    thr = distributed_initial_threshold_mxu(mesh, tf, spec, cfg_t)(
+        data, vol.origin, vol.spacing, cam)
+    step_t = distributed_hybrid_step_mxu(
+        mesh, tf, spec, cfg_t, comp, radius=0.05, stamp=3, temporal=True)
+    for _ in range(3):
+        (img_t, _), thr = step_t(data, vol.origin, vol.spacing, wsh, vsh,
+                                 cam, thr)
+    assert np.isfinite(np.asarray(img_t)).all()
+    q = psnr(np.asarray(img_h), np.asarray(img_t))
+    assert q > 27.0, f"PSNR {q:.1f} dB"

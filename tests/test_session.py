@@ -163,3 +163,39 @@ def test_session_soak_state_bounded():
     assert len(s._mxu_steps) <= 4
     assert len(s._mxu_thr) <= 4
     assert len(s._pending_meta) <= 2   # metadata snapshots are drained
+
+
+def test_session_plain_mxu_mode():
+    """Plain-image session on the slice-march engine: mode 'plain' no
+    longer routes the MXU engine through the gather raycaster."""
+    cfg = _cfg(**{"runtime.generate_vdis": "false",
+                  "slicer.engine": "mxu", "slicer.matmul_dtype": "f32"})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    assert sess.mode == "plain" and sess.engine == "mxu"
+    assert sess._step is None           # per-regime MXU steps, not gather
+    payload = sess.run(2)
+    assert payload["image"].shape == (4, 24, 32)
+    assert np.isfinite(payload["image"]).all()
+
+
+def test_session_hybrid_temporal_mode():
+    """Hybrid session with temporal thresholds: accepted (round 2 rejected
+    it), carries per-regime threshold state, 1 march/frame."""
+    cfg = _cfg(**{"sim.kind": "hybrid", "sim.num_particles": 64,
+                  "sim.particle_radius": 0.8,
+                  "slicer.engine": "mxu", "slicer.matmul_dtype": "f32",
+                  "vdi.adaptive_mode": "temporal"})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    assert sess._temporal
+    payload = sess.run(3)
+    assert payload["image"].shape == (4, 24, 32)
+    assert np.isfinite(payload["image"]).all()
+    assert any(k[0] == "hybrid" for k in sess._mxu_thr)
+
+
+def test_session_pending_meta_bounded_headless():
+    """run(fetch=False) must hold constant memory: the metadata snapshot
+    dict is bounded even though nothing ever fetches/pops it."""
+    sess = InSituSession(_cfg(), mesh=make_mesh(2))
+    sess.run(6, fetch=False)
+    assert len(sess._pending_meta) <= 2

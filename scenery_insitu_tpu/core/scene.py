@@ -82,10 +82,18 @@ class MultiGridScene:
 
     def update_grid(self, partner: int, gid: int, data) -> None:
         """New timestep for an existing grid (≅ updateVolume,
-        DistributedVolumes.kt:243-250)."""
+        DistributedVolumes.kt:243-250). Data only — the shape must match
+        the registered grid (callers cache extent-derived state on that
+        invariant); a repartition/refinement goes through `update_data`."""
         g = self._grids[(partner, gid)]
+        data = jnp.asarray(data, jnp.float32)
+        if tuple(data.shape) != tuple(g.volume.data.shape):
+            raise ValueError(
+                f"update_grid({partner}, {gid}): shape {tuple(data.shape)} "
+                f"!= registered {tuple(g.volume.data.shape)} — layout "
+                "changes must go through update_data")
         self._grids[(partner, gid)] = g._replace(
-            volume=g.volume._replace(data=jnp.asarray(data, jnp.float32)))
+            volume=g.volume._replace(data=data))
 
     @property
     def grids(self) -> List[SceneGrid]:

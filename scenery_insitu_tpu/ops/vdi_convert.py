@@ -196,3 +196,72 @@ def validate_vdi(vdi: VDI, ndc: bool = False,
             live & ((start < -1.0 - 1e-4) | (end > 1.0 + 1e-4))))
     rep["live_slots"] = int(np.sum(live))
     return rep
+
+
+# ------------------------------------- Vulkan reference-frame normalization
+#
+# The three conventions that break naive pixel comparison against the
+# Vulkan reference (SURVEY.md §7 "Image parity vs Vulkan"), as explicit,
+# individually-tested converters. The composition `to_reference_frame`
+# maps one of this framework's linear premultiplied images into the frame
+# a reference screenshot/dump lives in; with these, a Vulkan render (the
+# day one exists next to this repo) is comparable by plain PSNR, and the
+# golden-fixture tests (tests/test_golden.py) pin the protocol.
+
+
+def vulkan_projection_fix() -> np.ndarray:
+    """The reference's GL→Vulkan clip-space correction matrix (reference
+    DistributedVolumes.kt:67-79): Vulkan's NDC y points DOWN and its
+    depth range is [0, 1] where GL's is [-1, 1]. Left-multiply a GL-style
+    projection with this to get the matrix the reference's shaders used:
+    ``P_vk = fix @ P_gl`` → y' = -y, z' = (z + w)/2."""
+    return np.array([[1.0, 0.0, 0.0, 0.0],
+                     [0.0, -1.0, 0.0, 0.0],
+                     [0.0, 0.0, 0.5, 0.5],
+                     [0.0, 0.0, 0.0, 1.0]], np.float32)
+
+
+def projection_gl_to_vulkan(proj: jnp.ndarray) -> jnp.ndarray:
+    """GL-convention projection (what core/camera.py builds and all VDI
+    metadata carries) → the Vulkan-convention projection the reference
+    stored in its VDIData (its shaders consumed the fixed matrix:
+    VDIGenerator.comp uses ipv = inv(View)*inv(P_vk))."""
+    return jnp.asarray(vulkan_projection_fix()) @ proj
+
+
+def projection_vulkan_to_gl(proj_vk: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of `projection_gl_to_vulkan` — apply to matrices read from
+    reference-written VDIData dumps before using them with this
+    framework's GL-convention NDC math (depths_to/from_ndc)."""
+    return jnp.asarray(np.linalg.inv(vulkan_projection_fix())) @ proj_vk
+
+
+def gamma_encode(image: jnp.ndarray, gamma: float = 2.2) -> jnp.ndarray:
+    """The reference's write-time gamma on rgb (``pow(v, 1/2.2)``,
+    VDIGenerator.comp:537); alpha stays linear. Accepts [..., 4, H, W]
+    (channel-first, this framework's layout)."""
+    rgb = jnp.power(jnp.clip(image[..., :3, :, :], 0.0, 1.0), 1.0 / gamma)
+    return jnp.concatenate([rgb, image[..., 3:4, :, :]], axis=-3)
+
+
+def gamma_decode(image: jnp.ndarray, gamma: float = 2.2) -> jnp.ndarray:
+    """Inverse of `gamma_encode` (reference screenshots → linear)."""
+    rgb = jnp.power(jnp.clip(image[..., :3, :, :], 0.0, 1.0), gamma)
+    return jnp.concatenate([rgb, image[..., 3:4, :, :]], axis=-3)
+
+
+def flip_y(image: jnp.ndarray) -> jnp.ndarray:
+    """Row flip between this framework's top-down pixel rows and the
+    reference's bottom-up framebuffer order (the reference flips y when
+    re-projecting stored VDIs: ConvertToNDC.comp:238)."""
+    return image[..., ::-1, :]
+
+
+def to_reference_frame(image: jnp.ndarray, gamma: float = 2.2,
+                       flip: bool = True) -> jnp.ndarray:
+    """Linear premultiplied [4, H, W] (row 0 = top) → the reference
+    screenshot frame: gamma-encoded rgb, bottom-up rows. THE comparison
+    protocol: normalize ours with this, then plain PSNR against the
+    Vulkan image."""
+    out = gamma_encode(image, gamma)
+    return flip_y(out) if flip else out

@@ -68,9 +68,15 @@ class SceneSession:
         self._extent_cache = None
 
     def update_grid(self, partner: int, gid: int, data) -> None:
-        """≅ updateVolume(id, buffer) — new timestep for one grid."""
+        """≅ updateVolume(id, buffer) — new timestep for one grid.
+
+        Does NOT invalidate the extent cache: update_grid only replaces
+        grid DATA (MultiGridScene keeps origin/spacing/ghosts), so the
+        world extent cannot change — and the canonical driver loop calls
+        this every timestep, where a host/device sync per dispatch would
+        stall the async frame pipeline. Layout changes go through
+        `update_data`, which does invalidate."""
         self.scene.update_grid(partner, gid, data)
-        self._extent_cache = None
 
     # -------------------------------------------------------------- frames
     def render_frame(self) -> dict:
@@ -89,6 +95,9 @@ class SceneSession:
                     tuple(g.volume.origin for g in gs),
                     tuple(g.volume.spacing for g in gs), self.camera)
             if self._temporal:
+                from scenery_insitu_tpu.runtime.session import (
+                    drop_on_regime_reentry)
+                drop_on_regime_reentry(self, self._thr, key)
                 thr = self._thr.get(key)
                 if thr is None:     # seed on first frame of this regime
                     thr = self._thr_init[key](*args)

@@ -56,6 +56,19 @@ def drain_steering(sess) -> None:
                     cb(kind_msg)
 
 
+def drop_on_regime_reentry(sess, store: dict, key) -> None:
+    """Shared temporal-threshold policy of both sessions: when the camera
+    enters a regime key other than the previous frame's, drop that key's
+    carried threshold state so it re-seeds — a map frozen many frames ago
+    (while the camera was elsewhere and the data kept evolving) would cost
+    the controller several overflow-degraded frames to walk back. The
+    tracker attribute is checkpoint-restored VERBATIM (runtime/checkpoint)
+    so resumed runs make identical drop/keep decisions."""
+    if key != getattr(sess, "_last_regime_key", key):
+        store.pop(key, None)
+    sess._last_regime_key = key
+
+
 def advance_camera_and_index(sess) -> None:
     """Benchmark-orbit the camera (if enabled) and bump the frame index."""
     if sess.orbit_rate:
@@ -359,13 +372,7 @@ class InSituSession:
         return payload
 
     def _enter_regime(self, key) -> None:
-        """Regime switch: drop the entered regime's carried threshold so it
-        re-seeds — state frozen many frames ago (while the camera was in
-        another regime, with the sim evolving) would take the controller
-        several overflow-degraded frames to walk back."""
-        if key != getattr(self, "_last_regime_key", key):
-            self._mxu_thr.pop(key, None)
-        self._last_regime_key = key
+        drop_on_regime_reentry(self, self._mxu_thr, key)
 
     def _hybrid_dispatch(self):
         """Dispatch one distributed hybrid frame: volume VDI + tracers,

@@ -106,7 +106,8 @@ def multi_step_fast(state: GrayScott, n: int) -> GrayScott:
     `multi_step` (whose rolls XLA lowers to ICI halo exchanges) there."""
     from scenery_insitu_tpu.sim import pallas_stencil as ps
 
-    if jax.default_backend() != "tpu" or ps.pick_tz(state.u.shape) == 0:
+    if jax.default_backend() != "tpu" or not ps.fused_supported(
+            state.u.shape):
         return multi_step(state, n)
     p = state.params
     pvec = jnp.stack([p.f, p.k, p.du, p.dv, p.dt])

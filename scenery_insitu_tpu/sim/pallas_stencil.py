@@ -25,6 +25,7 @@ production CPU path stays on the XLA formulation.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -32,8 +33,49 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # nominal bytes of live blocks per grid step; Mosaic double-buffers the
-# pipelined inputs/outputs, so this must stay well under the ~128 MB VMEM
-_VMEM_BUDGET = 24 * 1024 * 1024
+# pipelined inputs/outputs, so this must stay well under the part's VMEM.
+# The figure is a HEURISTIC (not yet validated on hardware — the round-2
+# TPU window closed first): `fused_supported`/`multi_step_pallas` therefore
+# verify each (shape, T) choice with a one-time Mosaic compile probe and
+# degrade to smaller T / the XLA roll path instead of trusting it.
+_VMEM_BUDGET = int(os.environ.get("SITPU_STENCIL_VMEM_MB", "24")) \
+    * 1024 * 1024
+
+# (shape, t_steps) -> did Mosaic accept the fused kernel?
+_PROBE_CACHE: dict = {}
+
+
+def _compile_ok(shape, t_steps: int) -> bool:
+    """One-time probe: does the fused kernel at this (shape, T) actually
+    compile on the current TPU? A VMEM budget miss surfaces as a Mosaic
+    resource-exhausted error at compile time — catch it HERE, where a
+    fallback exists, not inside a traced frame step where it cannot be
+    caught. Cached per process (and cheap on repeats via the persistent
+    JAX compile cache)."""
+    key = (tuple(shape), int(t_steps))
+    ok = _PROBE_CACHE.get(key)
+    if ok is None:
+        try:
+            s = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
+            p = jax.ShapeDtypeStruct((5,), jnp.float32)
+            step_pallas.lower(s, s, p, t_steps=t_steps).compile()
+            ok = True
+        except Exception:
+            ok = False
+        _PROBE_CACHE[key] = ok
+    return ok
+
+
+def fused_supported(shape, t_steps: int = 1) -> bool:
+    """Can the fused kernel run this grid on the current backend? True iff
+    a slab fits the nominal budget AND (on TPU) Mosaic accepts the
+    kernel. The gate `sim.grayscott.multi_step_fast` consults before
+    choosing the Pallas path."""
+    if pick_tz(shape, t_steps) == 0:
+        return False
+    if jax.default_backend() != "tpu":
+        return True          # interpret mode has no VMEM to exhaust
+    return _compile_ok(shape, t_steps)
 
 
 def _roll(x: jnp.ndarray, shift: int, axis: int) -> jnp.ndarray:
@@ -128,10 +170,13 @@ def multi_step_pallas(u, v, params_vec, n: int, interpret: bool = False):
     whole loop to T=1)."""
     s = (u, v)
     remaining = n
+    on_tpu = jax.default_backend() == "tpu" and not interpret
     for t in range(min(_FUSE_T, n), 0, -1):
         reps = remaining // t
         if reps == 0 or pick_tz(u.shape, t) == 0:
             continue
+        if on_tpu and not _compile_ok(u.shape, t):
+            continue         # Mosaic rejected this T: degrade, don't die
         s = jax.lax.fori_loop(
             0, reps, lambda _, s, t=t: step_pallas(s[0], s[1], params_vec,
                                                    t, interpret=interpret),

@@ -225,3 +225,66 @@ def test_insitu_session_rejects_temporal():
     cfg = FrameworkConfig().with_overrides("vdi.adaptive_mode=temporal")
     with pytest.raises(ValueError, match="temporal"):
         InSituSession(cfg)
+
+
+def test_scene_session_extent_cache_survives_update_grid(vol, tf):
+    """update_grid replaces data only (origin/spacing unchanged), so the
+    extent cache must NOT be invalidated — the canonical driver loop
+    (update_grid every timestep, then render) would otherwise pay a
+    device sync per dispatch. update_data CAN change layout and must
+    invalidate."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.runtime.scene_session import SceneSession
+
+    cfg = FrameworkConfig().with_overrides(
+        "vdi.max_supersegments=4", "composite.max_output_supersegments=6",
+        "slicer.engine=mxu", "slicer.matmul_dtype=f32",
+        "runtime.dataset=procedural")
+    sess = SceneSession(cfg)
+    data = np.asarray(vol.data)
+    sess.update_data(0, [data], [np.asarray(vol.origin)], vol.spacing)
+    sess.render_frame()
+    assert sess._extent_cache is not None
+    cached = sess._extent_cache
+
+    sess.update_grid(0, 0, data * 0.5)
+    assert sess._extent_cache is cached     # same layout: no sync forced
+    sess.render_frame()
+
+    sess.update_data(0, [data], [np.asarray(vol.origin) + 1.0], vol.spacing)
+    assert sess._extent_cache is None       # layout change invalidates
+
+
+def test_scene_session_temporal_reseeds_on_regime_reentry(vol, tf):
+    """A camera returning to a previously visited march regime must NOT
+    reuse the threshold map frozen when it left (the grids kept updating):
+    the entry is dropped and re-seeded, mirroring InSituSession."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.runtime.scene_session import SceneSession
+
+    cfg = FrameworkConfig().with_overrides(
+        "vdi.max_supersegments=4", "vdi.adaptive_mode=temporal",
+        "composite.max_output_supersegments=6", "composite.adaptive_iters=1",
+        "slicer.engine=mxu", "slicer.matmul_dtype=f32",
+        "runtime.dataset=procedural")
+    sess = SceneSession(cfg)
+    data = np.asarray(vol.data)
+    sess.update_data(0, [data], [np.asarray(vol.origin)], vol.spacing)
+
+    cam_z = Camera.create((0.1, 0.2, 3.0), fov_y_deg=50.0, near=0.3,
+                          far=20.0)
+    cam_x = Camera.create((3.0, 0.2, 0.1), fov_y_deg=50.0, near=0.3,
+                          far=20.0)
+    sess.camera = cam_z
+    sess.render_frame()
+    (key_z,) = list(sess._thr)
+    stale = sess._thr[key_z]
+
+    sess.camera = cam_x                      # leave the +z regime
+    sess.render_frame()
+    sess.update_grid(0, 0, data * 0.25)      # grids evolve meanwhile
+
+    sess.camera = cam_z                      # return: must re-seed
+    sess.render_frame()
+    assert sess._thr[key_z] is not stale

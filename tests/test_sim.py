@@ -99,3 +99,20 @@ def test_pallas_multistep_remainder():
     ref = gs.multi_step(st, 6)
     np.testing.assert_allclose(np.asarray(ref.u), np.asarray(u2), atol=1e-5)
     np.testing.assert_allclose(np.asarray(ref.v), np.asarray(v2), atol=1e-5)
+
+
+def test_stencil_compile_probe_gates_fused_path():
+    """fused_supported must reject (without raising) kernels the backend
+    cannot compile: on CPU the Mosaic lowering of step_pallas fails, so
+    _compile_ok catches and caches False — the degrade path a real-TPU
+    VMEM budget miss takes."""
+    from scenery_insitu_tpu.sim import pallas_stencil as ps
+
+    shape = (8, 8, 128)
+    assert ps.pick_tz(shape) > 0
+    ps._PROBE_CACHE.clear()
+    assert ps._compile_ok(shape, 1) is False      # swallowed, not raised
+    assert ps._PROBE_CACHE[(shape, 1)] is False   # cached
+    # fused_supported skips the probe off-TPU (interpret mode is safe)
+    assert ps.fused_supported(shape)
+    ps._PROBE_CACHE.clear()

@@ -1,0 +1,145 @@
+"""Generate the committed golden fixtures (run from the repo root):
+
+    JAX_PLATFORMS=cpu python tests/golden/make_golden.py
+
+Writes small deterministic renders of BOTH engines + a VDI artifact into
+tests/golden/. tests/test_golden.py regenerates the same configs and
+compares within tolerance — a kernel regression breaks a committed-image
+test (the reference validated exactly this way, by re-rendering stored
+dumps on screen: SURVEY.md §4.2; here the comparison is mechanical).
+
+Regenerate (and commit the diff) ONLY when an intentional rendering
+change shifts the images; the test failure message says which config.
+"""
+
+from __future__ import annotations
+
+import os
+
+GOLDEN_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# one shared tiny scene: deterministic procedural volume, fixed cameras
+GRID = 32
+W, H = 96, 72
+SEED = 11
+EYE = (0.35, 0.55, 2.7)
+EYE_NOVEL = (0.9, 0.15, 2.4)
+K = 6
+STEPS = 96
+
+
+def build_vdi(fold: str = "xla"):
+    """Config 3's scene through VDI generate (histogram) + composite —
+    shared by build_all and test_golden's Pallas schedule-independence
+    check so the two can never drift apart. Returns (comp, meta, spec)."""
+    from scenery_insitu_tpu.config import (CompositeConfig,
+                                           SliceMarchConfig, VDIConfig)
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import procedural_volume
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.ops.composite import composite_vdis
+
+    vol = procedural_volume(GRID, kind="blobs", seed=SEED)
+    cam = Camera.create(EYE, fov_y_deg=50.0, near=0.3, far=20.0)
+    spec = slicer.make_spec(cam, vol.data.shape,
+                            SliceMarchConfig(matmul_dtype="f32", fold=fold))
+    vdi, meta, _ = slicer.generate_vdi_mxu(
+        vol, for_dataset("procedural"), cam, spec,
+        VDIConfig(max_supersegments=K, adaptive_mode="histogram",
+                  histogram_bins=8))
+    comp = composite_vdis(vdi.color[None], vdi.depth[None],
+                          CompositeConfig(max_output_supersegments=K))
+    return comp, meta, spec
+
+
+def build_all(out_dir: str) -> dict:
+    """Render every golden config; returns {name: array} (also saved when
+    ``out_dir`` is set)."""
+    import numpy as np
+
+    from scenery_insitu_tpu.config import (CompositeConfig, RenderConfig,
+                                           SliceMarchConfig, VDIConfig)
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.vdi import VDI, render_vdi_same_view
+    from scenery_insitu_tpu.core.volume import procedural_volume
+    from scenery_insitu_tpu.ops import slicer, vdi_convert
+    from scenery_insitu_tpu.ops.composite import composite_vdis
+    from scenery_insitu_tpu.ops.raycast import raycast
+    from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
+    from scenery_insitu_tpu.ops.vdi_render import render_vdi
+
+    vol = procedural_volume(GRID, kind="blobs", seed=SEED)
+    tf = for_dataset("procedural")
+    cam = Camera.create(EYE, fov_y_deg=50.0, near=0.3, far=20.0)
+    bg = (1.0, 1.0, 1.0, 1.0)
+    out = {}
+
+    # 1. gather-path plain raycast (the portable reference engine)
+    rc = raycast(vol, tf, cam, W, H,
+                 RenderConfig(max_steps=STEPS, background=bg))
+    out["raycast_gather"] = np.asarray(rc.image)
+
+    # 2. MXU slice-march plain render, homography-warped to the same camera
+    spec = slicer.make_spec(cam, vol.data.shape,
+                            SliceMarchConfig(matmul_dtype="f32",
+                                             fold="xla"))
+    mx = slicer.raycast_mxu(vol, tf, cam, W, H, spec, background=bg)
+    out["raycast_mxu"] = np.asarray(mx.image)
+
+    # 3. VDI generate (histogram) -> composite -> same-view decode; the
+    #    VDI tensors themselves are a fixture (replay food for the
+    #    compositor / novel-view clients)
+    comp, meta, _ = build_vdi(fold="xla")
+    out["vdi_color"] = np.asarray(comp.color)
+    out["vdi_depth"] = np.asarray(comp.depth)
+    out["vdi_decode"] = np.asarray(render_vdi_same_view(
+        VDI(comp.color, comp.depth), background=bg))
+
+    # 4. novel-view render of the stored VDI from an offset camera
+    #    (portable gather client — the EfficientVDIRaycast role)
+    cam2 = Camera.create(EYE_NOVEL, fov_y_deg=50.0, near=0.3, far=20.0)
+    out["novel_view"] = np.asarray(render_vdi(
+        VDI(comp.color, comp.depth), meta, cam2, W, H, steps=STEPS,
+        background=bg))
+
+    # 5. gather-path VDI for cross-engine coverage
+    vdi_g, _ = generate_vdi(vol, tf, cam, W, H,
+                            VDIConfig(max_supersegments=K,
+                                      adaptive_iters=4),
+                            max_steps=STEPS)
+    out["vdi_gather_decode"] = np.asarray(render_vdi_same_view(
+        vdi_g, background=bg))
+
+    # 6. the Vulkan reference-frame normalization of config 2 — pins the
+    #    comparison protocol (gamma + y-flip) as a golden image
+    out["reference_frame"] = np.asarray(
+        vdi_convert.to_reference_frame(mx.image))
+
+    if out_dir:
+        from scenery_insitu_tpu.utils.image import save_png
+
+        np.savez_compressed(
+            os.path.join(out_dir, "golden_vdi.npz"),
+            color=out["vdi_color"], depth=out["vdi_depth"])
+        for name in ("raycast_gather", "raycast_mxu", "vdi_decode",
+                     "novel_view", "vdi_gather_decode"):
+            save_png(os.path.join(out_dir, f"golden_{name}.png"), out[name])
+        # reference_frame is ALREADY gamma-encoded by to_reference_frame —
+        # store with gamma=1.0 so the PNG carries exactly one encode (the
+        # pixels a Vulkan screenshot of the same config would hold)
+        save_png(os.path.join(out_dir, "golden_reference_frame.png"),
+                 out["reference_frame"], gamma=1.0)
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from scenery_insitu_tpu.utils.backend import pin_cpu_backend
+
+    pin_cpu_backend()
+    arrays = build_all(GOLDEN_DIR)
+    print("wrote", sorted(arrays), "to", GOLDEN_DIR)

@@ -85,3 +85,26 @@ def test_render_bench_cli(tmp_path):
     assert os.path.exists(tmp_path / "fps_procedural_gather_plain.csv")
     shots = tmp_path / "procedural_gather_plain"
     assert sorted(os.listdir(shots)) == ["view00.png", "view01.png"]
+
+
+def test_scaling_bench_cli():
+    """Scaling sweep smoke: runs 1->4 on the virtual mesh, emits one JSON
+    line with per-n fps/efficiency/all_to_all rows (the BASELINE scaling
+    metric's ready-to-run harness)."""
+    import json
+
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "/root/repo/benchmarks/scaling_bench.py",
+         "--max-ranks", "4", "--grid", "16", "--k", "4",
+         "--frames", "2", "--sim-steps", "2"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    ns = [row["n"] for row in rep["sweep"]]
+    assert ns == [1, 2, 4]
+    assert rep["sweep"][0]["efficiency"] == 1.0
+    for row in rep["sweep"]:
+        assert row["fps"] > 0
+        if row["n"] > 1:
+            assert row["all_to_all_ms"] > 0

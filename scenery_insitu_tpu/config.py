@@ -31,6 +31,11 @@ class RenderConfig:
     gamma: float = 2.2             # display gamma applied at host boundary
     background: Tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
     early_exit_alpha: float = 0.999  # ≅ AccumulatePlainImage.comp early exit
+    # Ambient occlusion (off by default, like the reference's inactive
+    # scaffolding ComputeRaycast.comp:147-191): 0 disables; > 0 darkens
+    # samples by the blurred-opacity occlusion field (ops/ao.py).
+    ao_strength: float = 0.0
+    ao_radius: int = 4               # occlusion neighborhood radius, voxels
 
 
 @dataclass(frozen=True)

@@ -254,7 +254,11 @@ class MultiGridScene:
         from scenery_insitu_tpu.ops.raycast import raycast
 
         cfg = cfg or RenderConfig(width=width, height=height)
-        rank_cfg = dataclasses.replace(cfg, background=(0.0,) * 4)
+        # background blended once at the composite; AO off per grid — a
+        # per-grid occlusion blur edge-clamps at grid boundaries instead
+        # of seeing neighbor grids (single-volume feature, ops/ao.py)
+        rank_cfg = dataclasses.replace(cfg, background=(0.0,) * 4,
+                                       ao_strength=0.0)
         outs = [raycast(g.volume, tf, cam, width, height, rank_cfg,
                         clip_min=g.interior_min, clip_max=g.interior_max)
                 for g in self.grids]

@@ -42,13 +42,23 @@ def raycast(vol: Volume, tf: TransferFunction, cam: Camera,
             width: int, height: int, cfg: Optional[RenderConfig] = None,
             clip_min: Optional[jnp.ndarray] = None,
             clip_max: Optional[jnp.ndarray] = None,
+            ao_field: Optional[Volume] = None,
             ) -> RaycastOutput:
     """clip_min/clip_max override the ray-clipping AABB — used by the
     distributed pipeline so a rank renders exactly its domain region while
     its Volume carries halo slices for seam-exact boundary interpolation
     (the reference instead positions per-rank Volume nodes at their grid
-    origins: DistributedVolumeRenderer.kt:341-386)."""
+    origins: DistributedVolumeRenderer.kt:341-386).
+
+    ``ao_field`` (or ``cfg.ao_strength > 0``, which builds one): ambient
+    occlusion volume sampled per step, darkening rgb by ``1 - occ``
+    (≅ ComputeRaycast.comp:147-191's inactive AO scaffolding; see
+    ops/ao.py for the TPU re-derivation)."""
     cfg = cfg or RenderConfig(width=width, height=height)
+    if ao_field is None and cfg.ao_strength > 0.0:
+        from scenery_insitu_tpu.ops.ao import ao_field_volume
+
+        ao_field = ao_field_volume(vol, tf, cfg.ao_radius, cfg.ao_strength)
     origin, dirs = pixel_rays(cam, width, height)          # [3], [3, H, W]
     box_min = vol.world_min if clip_min is None else clip_min
     box_max = vol.world_max if clip_max is None else clip_max
@@ -66,6 +76,10 @@ def raycast(vol: Volume, tf: TransferFunction, cam: Camera,
         pos = origin.reshape(3, 1, 1) + t[None] * dirs     # [3, H, W]
         val = sample_volume_world(vol, jnp.moveaxis(pos, 0, -1))
         rgb, a = tf(val)                                   # [H,W,3], [H,W]
+        if ao_field is not None:
+            occ = sample_volume_world(ao_field,
+                                      jnp.moveaxis(pos, 0, -1))
+            rgb = rgb * (1.0 - occ)[..., None]
         a = adjust_opacity(a, dt / nw)
         a = jnp.where(hit & (acc[3] < cfg.early_exit_alpha), a, 0.0)
         src = jnp.concatenate([jnp.moveaxis(rgb, -1, 0) * a[None], a[None]])

@@ -452,8 +452,13 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
 
     # rank partials must stay background-free — the background is blended
     # exactly once, by the final composite (blending it per rank would
-    # occlude farther ranks for any non-transparent background)
-    rank_cfg = dataclasses.replace(cfg, background=(0.0, 0.0, 0.0, 0.0))
+    # occlude farther ranks for any non-transparent background). AO is
+    # also forced off: each rank's occlusion blur would edge-clamp at its
+    # 1-voxel halo instead of seeing the neighbor's ao_radius slices,
+    # banding the seams — AO is a single-device feature until radius-deep
+    # halos exist (ops/ao.py).
+    rank_cfg = dataclasses.replace(cfg, background=(0.0, 0.0, 0.0, 0.0),
+                                   ao_strength=0.0)
 
     def step(local_data, origin, spacing, cam: Camera) -> jnp.ndarray:
         d_global = local_data.shape[0] * n

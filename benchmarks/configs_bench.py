@@ -66,10 +66,12 @@ def run_config(n: int, scale: str, frames: int) -> dict:
         f"mesh.num_devices={c['ranks']}",
         "sim.steps_per_frame=5",
         "vdi.max_supersegments=16",
-        # volume configs: flagship engine + carried temporal thresholds
-        # (mxu also runs on the CPU mesh — make_spec downgrades the
-        # matmul dtype); particle/hybrid paths use histogram instead
-        ("vdi.adaptive_mode=temporal" if volume_vdi
+        # volume + hybrid configs: flagship engine + carried temporal
+        # thresholds (mxu also runs on the CPU mesh — make_spec downgrades
+        # the matmul dtype); hybrid gained temporal support in round 3, so
+        # Config 5 now pays ONE march/frame like the plain VDI path
+        ("vdi.adaptive_mode=temporal"
+         if volume_vdi or c["kind"] == "hybrid"
          else "vdi.adaptive_mode=histogram"),
         "composite.max_output_supersegments=16",
     ]

@@ -1,9 +1,9 @@
 #!/bin/bash
 # Round-3 TPU watcher: poll the axon tunnel; the moment it answers, capture
 # every TPU number VERDICT.md round 2 asked for (items 1 and 6):
-#   - flagship bench, temporal defaults, 25 frames      -> bench_tpu_r3.json
+#   - flagship bench, TPU defaults (512^3, 25 frames)   -> bench_tpu_r3_512.json
 #   - histogram-mode comparison at the same scale       -> bench_tpu_r3_hist.json
-#   - BASELINE primary metric: Gray-Scott 512^3         -> bench_tpu_r3_512.json
+#   - 256^3 run comparable to the round-2 capture       -> bench_tpu_r3_256.json
 #   - novel-view client vs portable gather renderer     -> novel_view_tpu_r3.json
 #   - composite bench on the real chip                  -> composite_tpu_r3.json
 #   - steady-state march profile (where the ms go)      -> profile_march_tpu_r3.txt
@@ -34,16 +34,17 @@ assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) > 0
 " 2>/dev/null; then
     echo "tunnel alive at $(date -u) attempt $i" | tee /tmp/tpu_watcher_r3.log
     date -u > "$R/tpu_alive_r3.marker"
-    step "$R/bench_tpu_r3.json" 1800 env SITPU_BENCH_FRAMES=25 \
-      SITPU_BENCH_PLATFORMS=tpu,tpu python bench.py
-    cat "$R/bench_tpu_r3.json" 2>/dev/null
-    step "$R/bench_tpu_r3_hist.json" 1800 env SITPU_BENCH_FRAMES=25 \
-      SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_ADAPTIVE_MODE=histogram \
+    # outer window must fit BOTH tpu attempts (pallas + xla-fold rescue)
+    step "$R/bench_tpu_r3_512.json" 3600 env \
+      SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=1700 \
       python bench.py
-    step "$R/bench_tpu_r3_512.json" 1800 env SITPU_BENCH_GRID=512 \
-      SITPU_BENCH_FRAMES=25 SITPU_BENCH_PLATFORMS=tpu,tpu \
-      SITPU_BENCH_CHILD_TIMEOUT=1700 python bench.py
     cat "$R/bench_tpu_r3_512.json" 2>/dev/null
+    step "$R/bench_tpu_r3_hist.json" 1800 env \
+      SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_ADAPTIVE_MODE=histogram \
+      SITPU_BENCH_CHILD_TIMEOUT=1700 python bench.py
+    step "$R/bench_tpu_r3_256.json" 2000 env SITPU_BENCH_GRID=256 \
+      SITPU_BENCH_PLATFORMS=tpu,tpu python bench.py
+    cat "$R/bench_tpu_r3_256.json" 2>/dev/null
     step "$R/novel_view_tpu_r3.json" 1500 \
       python benchmarks/novel_view_bench.py --iters 3
     step "$R/composite_tpu_r3.json" 1200 env SITPU_BENCH_REAL=1 \

@@ -35,14 +35,14 @@ assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) > 0
     echo "tunnel alive at $(date -u) attempt $i" | tee /tmp/tpu_watcher_r3.log
     date -u > "$R/tpu_alive_r3.marker"
     # outer window must fit BOTH tpu attempts (pallas + xla-fold rescue)
-    step "$R/bench_tpu_r3_512.json" 3600 env \
+    step "$R/bench_tpu_r3_512.json" 4000 env \
       SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=1700 \
       python bench.py
     cat "$R/bench_tpu_r3_512.json" 2>/dev/null
-    step "$R/bench_tpu_r3_hist.json" 1800 env \
+    step "$R/bench_tpu_r3_hist.json" 2100 env \
       SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_ADAPTIVE_MODE=histogram \
       SITPU_BENCH_CHILD_TIMEOUT=1700 python bench.py
-    step "$R/bench_tpu_r3_256.json" 2000 env SITPU_BENCH_GRID=256 \
+    step "$R/bench_tpu_r3_256.json" 2400 env SITPU_BENCH_GRID=256 \
       SITPU_BENCH_PLATFORMS=tpu,tpu python bench.py
     cat "$R/bench_tpu_r3_256.json" 2>/dev/null
     step "$R/novel_view_tpu_r3.json" 1500 \
@@ -55,6 +55,10 @@ assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) > 0
     else
       rm -f "$R/profile_march_tpu_r3.txt.tmp"
     fi
+    step "$R/profile_frame_tpu_r3.json" 1200 \
+      python benchmarks/profile_frame.py --out "$R/trace_r3"
+    step "$R/scaling_tpu_r3.json" 1800 env SITPU_BENCH_REAL=1 \
+      python benchmarks/scaling_bench.py --grid 128 --frames 10
     echo "suite done at $(date -u)" >> /tmp/tpu_watcher_r3.log
     exit 0
   fi

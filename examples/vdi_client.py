@@ -20,6 +20,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--connect", default="tcp://localhost:6655")
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="seconds to wait for each VDI (a cold producer "
+                         "may need a minute+ of jax compile first)")
     ap.add_argument("--frames", type=int, default=10)
     ap.add_argument("--out", default="client_out")
     ap.add_argument("--width", type=int, default=512)
@@ -46,12 +49,13 @@ def main():
         from scenery_insitu_tpu.runtime.streaming import SteeringPublisher
         steer = SteeringPublisher(args.steer)
 
-    print(f"listening on {args.connect} …")
+    print(f"listening on {args.connect} …", flush=True)
     for i in range(args.frames):
-        got = sub.receive(timeout_ms=30000)
+        got = sub.receive(timeout_ms=int(args.timeout * 1000))
         if got is None:
-            print("no VDI within 30 s; is a producer publishing?")
-            break
+            print(f"no VDI within {args.timeout:.0f} s; is a producer "
+                  "publishing?", flush=True)
+            sys.exit(2)
         vdi, meta = got
         # rebuild the generating camera's slice geometry from METADATA ONLY
         spec0 = vdi_novel.axis_spec_from_meta(meta)
@@ -67,7 +71,7 @@ def main():
         save_png(os.path.join(args.out, f"novel{i:03d}.png"),
                  np.asarray(img))
         print(f"frame {int(meta.index)}: rendered novel view "
-              f"({i + 1}/{args.frames})")
+              f"({i + 1}/{args.frames})", flush=True)
         if steer is not None:
             from scenery_insitu_tpu.runtime.streaming import (
                 make_camera_message)

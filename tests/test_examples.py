@@ -52,15 +52,37 @@ def test_producer_client_pair(tmp_path):
     out = str(tmp_path / "client")
     port = 16655 + os.getpid() % 1000
     client = subprocess.Popen(
-        [sys.executable, os.path.join(_EX, "vdi_client.py"),
+        [sys.executable, "-u", os.path.join(_EX, "vdi_client.py"),
          "--connect", f"tcp://localhost:{port}", "--frames", "1",
+         "--timeout", "240",        # cold producer compiles first
          "--width", "48", "--height", "48", "--out", out],
         env={**os.environ, "PYTHONPATH": _ROOT, "JAX_PLATFORMS": "cpu",
              "_EX_CHILD": "1"},
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
+        import select
         import time
-        time.sleep(2)          # let the SUB socket connect
+        # readiness handshake, not a fixed sleep: the client prints
+        # "listening" AFTER its (jax-import-heavy) startup subscribes —
+        # under machine load that startup can far outlive any sleep, and
+        # the PUB's frames would all fire before the SUB joins. Tolerate
+        # import-time warning lines on the merged pipe, and bound the
+        # wait so a wedged client cannot hang the suite.
+        deadline = time.time() + 180
+        seen = []
+        while time.time() < deadline:
+            r, _, _ = select.select([client.stdout], [], [], 5)
+            if not r:
+                continue
+            line = client.stdout.readline()
+            if not line:                   # EOF: client died during start
+                break
+            seen.append(line)
+            if "listening" in line:
+                break
+        assert any("listening" in ln for ln in seen), \
+            f"client never became ready; output so far: {seen[-5:]}"
+        time.sleep(1.0)        # ZMQ slow-joiner: let the join propagate
         p = _run("volume_from_file.py", "--out", str(tmp_path / "v"),
                  "--views", "3", "--width", "32", "--height", "32",
                  "--publish", f"tcp://*:{port}")

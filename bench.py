@@ -122,6 +122,7 @@ def main():
     # so the gather engine downgrades to histogram.
     ad_mode = os.environ.get("SITPU_BENCH_ADAPTIVE_MODE", "temporal")
     fold = os.environ.get("SITPU_BENCH_FOLD", "auto")
+    chunk = _env_int("SITPU_BENCH_CHUNK", 16)   # slices per fold kernel
 
     from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
@@ -133,7 +134,7 @@ def main():
         ad_mode = "histogram"
 
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
-    march_cfg = SliceMarchConfig(fold=fold)
+    march_cfg = SliceMarchConfig(fold=fold, chunk=chunk)
     frame_step = grayscott_vdi_frame_step(
         width, height, sim_steps=sim_steps, max_steps=steps,
         vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,
@@ -227,6 +228,7 @@ def main():
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
+                   "chunk": chunk,
                    "compile_s": round(compile_s, 1),
                    "platform": platform, "device": dev.device_kind,
                    "assumed_peak_tflops": (peak / 1e12 if peak else None),

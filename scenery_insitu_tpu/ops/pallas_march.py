@@ -269,3 +269,58 @@ def init_count_multi_packed(bins: int, height: int, width: int):
     return (jnp.zeros((bins, height, width), jnp.int32),
             jnp.zeros((3, height, width), jnp.float32),
             jnp.ones((height, width), jnp.float32))
+
+
+# ------------------------------------------------------------ compile probe
+
+_FOLD_PROBE: dict = {}
+
+
+def fold_compile_ok(max_k: int = 32, chunk: int = 16,
+                    width: int = 2048) -> bool:
+    """One-time probe: does Mosaic accept the fold kernel AT THIS SHAPE on
+    the current backend? Like sim/pallas_stencil._compile_ok, this
+    catches a compile rejection (typically VMEM exhaustion — shape
+    dependent, so the probe must use the real K/chunk/width, not a toy
+    shape) HERE, where `slicer.make_spec`'s "auto" resolution can fall
+    back to the XLA fold — instead of inside a traced frame step (e.g.
+    the driver's entry() compile check) where nothing can. The kernel's
+    VMEM use per strip scales with (max_k, chunk, width) and is
+    height-independent (one TILE_H strip per grid step); defaults are
+    conservative upper bounds for this framework's configs. Cached per
+    (backend, shape); failures are warned, not silent."""
+    key = (jax.default_backend(), int(max_k), int(chunk), int(width))
+    ok = _FOLD_PROBE.get(key)
+    if ok is None:
+        try:
+            k, c, h, w = int(max_k), int(chunk), TILE_H, int(width)
+            sds = jax.ShapeDtypeStruct
+            packed = (sds((k, 4, h, w), jnp.float32),
+                      sds((k, 2, h, w), jnp.float32),
+                      sds((4, h, w), jnp.float32),
+                      sds((2, h, w), jnp.float32),
+                      sds((3, h, w), jnp.float32),
+                      sds((2, h, w), jnp.float32),
+                      sds((h, w), jnp.int32))
+
+            def f(packed, rgba, t0, t1, thr, count):
+                return fold_chunk(packed, rgba, t0, t1, thr, max_k=k,
+                                  count=count)
+
+            jax.jit(f).lower(
+                packed, sds((c, 4, h, w), jnp.float32),
+                sds((c, h, w), jnp.float32), sds((c, h, w), jnp.float32),
+                sds((h, w), jnp.float32), sds((h, w), jnp.int32)).compile()
+            ok = True
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"Pallas march fold rejected at k={max_k} chunk={chunk} "
+                f"width={width} ({type(e).__name__}: {str(e)[:200]}) — "
+                "falling back to the XLA fold schedule. If this was a "
+                "transient backend error, restart the process or set "
+                "fold='pallas' explicitly.", stacklevel=2)
+            ok = False
+        _FOLD_PROBE[key] = ok
+    return ok

@@ -133,15 +133,22 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     dtype = cfg.matmul_dtype
     if dtype == "bf16" and jax.default_backend() != "tpu":
         dtype = "f32"
+    ni = rnd(dims_xyz[u_axis])
+    nj = rnd(dims_xyz[v_axis])
     fold = cfg.fold
     if fold == "auto":
-        # interpret-mode pallas is far slower than the XLA scan on CPU
-        fold = "pallas" if jax.default_backend() == "tpu" else "xla"
+        # interpret-mode pallas is far slower than the XLA scan on CPU;
+        # on TPU a one-time Mosaic compile probe AT THIS SPEC'S strip
+        # width (K probed at a conservative 32 — VDIConfig's K is not
+        # known here) gates the kernel so a hardware/compiler rejection
+        # degrades to the XLA fold instead of failing inside a traced
+        # frame step (same pattern as the fused sim stencil's probe)
+        fold = ("pallas" if jax.default_backend() == "tpu"
+                and pm.fold_compile_ok(32, cfg.chunk, ni) else "xla")
     if fold not in ("xla", "pallas"):
         raise ValueError(f"unknown fold schedule {fold!r} "
                          "(expected 'auto', 'xla' or 'pallas')")
-    return AxisSpec(axis=axis, sign=sign,
-                    ni=rnd(dims_xyz[u_axis]), nj=rnd(dims_xyz[v_axis]),
+    return AxisSpec(axis=axis, sign=sign, ni=ni, nj=nj,
                     chunk=cfg.chunk, matmul_dtype=dtype,
                     s_floor=cfg.s_floor, skip_empty=cfg.skip_empty,
                     fold=fold)

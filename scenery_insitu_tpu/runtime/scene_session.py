@@ -58,6 +58,22 @@ class SceneSession:
                           and self.engine == "mxu"
                           and self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal")
+        # runtime TF updates: drop compiled steps (TF is baked in)
+        self.on_steer.append(self._apply_tf_message)
+
+    def _apply_tf_message(self, msg: dict) -> None:
+        """'tf' steering: drop the per-signature step/threshold caches so
+        the next frame compiles with the new transfer function. Shared
+        protocol logic (parsing, malformed-payload containment) lives in
+        session.apply_tf_steering."""
+        from scenery_insitu_tpu.runtime.session import apply_tf_steering
+
+        def invalidate():
+            self._steps.clear()
+            self._thr.clear()
+            self._thr_init.clear()
+
+        apply_tf_steering(self, msg, invalidate)
 
     # ------------------------------------------------- operator boundary
     def update_data(self, partner: int, grids, origins, spacing,

@@ -56,6 +56,26 @@ def drain_steering(sess) -> None:
                     cb(kind_msg)
 
 
+def apply_tf_steering(sess, msg: dict, invalidate) -> None:
+    """Shared handler for 'tf' steering messages (the reference's
+    updateVis TF path, DistributedVolumeRenderer.kt:747-774): swap
+    ``sess.tf`` and call ``invalidate()`` to drop the compiled steps that
+    baked the old TF in as constants. Malformed payloads are logged and
+    IGNORED — the steering socket is network-facing, and a buggy viewer
+    must not be able to kill an in-situ run mid-simulation."""
+    if msg.get("type") != "tf":
+        return
+    from scenery_insitu_tpu.runtime.streaming import tf_from_message
+
+    try:
+        tf = tf_from_message(msg)
+    except Exception as e:
+        sess.log(f"ignoring malformed tf steering message: {e!r}")
+        return
+    sess.tf = tf
+    invalidate()
+
+
 def drop_on_regime_reentry(sess, store: dict, key) -> None:
     """Shared temporal-threshold policy of both sessions: when the camera
     enters a regime key other than the previous frame's, drop that key's
@@ -210,10 +230,34 @@ class InSituSession:
         self.on_steer: List[Callable[[dict], None]] = []  # non-camera msgs
         self._pending_meta = {}  # frame index -> VDIMetadata at dispatch
 
-        r = self.cfg.render
         from scenery_insitu_tpu.ops import slicer as _slicer
         self._slicer = _slicer
         self.engine = _slicer.resolve_engine(self.cfg.slicer.engine)
+        self._build_steps()
+        # runtime TF updates (the reference's updateVis TF payload):
+        # rebuild the compiled steps — the TF is baked in as constants
+        self.on_steer.append(self._apply_tf_message)
+
+        # world placement: sim grid centered, largest side = 2 world units
+        if self.mode == "particles":
+            # particle box [0, box) is rendered centered by the step itself
+            d = h = w = 1
+            self._origin = jnp.zeros((3,), jnp.float32)
+            self._spacing = jnp.ones((3,), jnp.float32)
+        else:
+            d, h, w = (tuple(self.cfg.sim.grid) if sim is None
+                       else np.asarray(self.sim.field.shape))
+            vox = 2.0 / max(d, h, w)
+            self._origin = jnp.asarray(
+                [-w * vox / 2, -h * vox / 2, -d * vox / 2], jnp.float32)
+            self._spacing = jnp.full((3,), vox, jnp.float32)
+
+    def _build_steps(self) -> None:
+        """(Re)build the distributed steps for the current mode/engine/TF
+        and reset the per-regime caches. Called at construction and after
+        a runtime transfer-function change (the TF is a compile-time
+        constant of every step)."""
+        r = self.cfg.render
         self._mxu_steps = {}   # regime key -> jitted distributed step
         self._mxu_thr = {}     # regime key -> temporal threshold state
         self.mode = "vdi"
@@ -266,19 +310,12 @@ class InSituSession:
                 f"{self.mode!r} engine={self.engine!r}; use 'histogram' "
                 "there")
 
-        # world placement: sim grid centered, largest side = 2 world units
-        if self.mode == "particles":
-            # particle box [0, box) is rendered centered by the step itself
-            d = h = w = 1
-            self._origin = jnp.zeros((3,), jnp.float32)
-            self._spacing = jnp.ones((3,), jnp.float32)
-        else:
-            d, h, w = (tuple(self.cfg.sim.grid) if sim is None
-                       else np.asarray(self.sim.field.shape))
-            vox = 2.0 / max(d, h, w)
-            self._origin = jnp.asarray(
-                [-w * vox / 2, -h * vox / 2, -d * vox / 2], jnp.float32)
-            self._spacing = jnp.full((3,), vox, jnp.float32)
+    def _apply_tf_message(self, msg: dict) -> None:
+        """'tf' steering: rebuild the compiled steps with the new TF (knot
+        arrays are fixed-shape, so pipeline shapes never change; the
+        recompile and temporal re-seed are the cost of a rare user
+        action). Shared protocol logic lives in `apply_tf_steering`."""
+        apply_tf_steering(self, msg, self._build_steps)
 
     # ------------------------------------------------------------- frames
 

@@ -135,6 +135,27 @@ def make_camera_message(cam: Camera) -> dict:
             "fov_y": float(np.asarray(cam.fov_y))}
 
 
+def make_tf_message(points, colormap: str = "hot") -> dict:
+    """Viewer -> renderer transfer-function update (≅ updateVis's TF
+    payload, DistributedVolumeRenderer.kt:747-774 — there dispatched by
+    payload size, here an explicit type). ``points`` are (value, alpha)
+    control points; the renderer rebuilds its TF and recompiles the
+    affected steps (rare user action; knot arrays are fixed-shape, so
+    the pipeline shapes never change)."""
+    return {"type": "tf",
+            "points": [[float(v), float(a)] for v, a in points],
+            "colormap": str(colormap)}
+
+
+def tf_from_message(msg: dict):
+    """Build the TransferFunction a 'tf' steering message describes."""
+    from scenery_insitu_tpu.core.transfer import TransferFunction
+
+    return TransferFunction.points(
+        [tuple(p) for p in msg["points"]],
+        colormap=msg.get("colormap", "hot"))
+
+
 def apply_steering(cam: Camera, msg: dict) -> Tuple[Camera, dict]:
     """Apply one steering message; returns (camera, side_effects). Unknown
     types pass through in side_effects (≅ updateVis dispatch,

@@ -303,3 +303,72 @@ def test_streamed_mxu_vdi_client_renders_novel_view():
     finally:
         pub.close()
         sub.close()
+
+
+def test_tf_message_roundtrip():
+    from scenery_insitu_tpu.runtime.streaming import (make_tf_message,
+                                                      tf_from_message)
+
+    msg = make_tf_message([(0.1, 0.0), (0.8, 0.9)], colormap="hot")
+    assert msg["type"] == "tf"
+    tf = tf_from_message(msg)
+    import jax.numpy as jnp
+    import numpy as np
+    _, a = tf(jnp.asarray([0.05, 0.8]))
+    np.testing.assert_allclose(np.asarray(a), [0.0, 0.9], atol=1e-5)
+
+
+def test_session_applies_tf_steering():
+    """A 'tf' steering message swaps the session's transfer function and
+    rebuilds the compiled steps — the reference's updateVis TF path."""
+    import numpy as np
+
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.runtime.session import InSituSession
+    from scenery_insitu_tpu.runtime.streaming import make_tf_message
+
+    cfg = FrameworkConfig().with_overrides(
+        "render.width=32", "render.height=24", "render.max_steps=24",
+        "vdi.max_supersegments=6", "composite.max_output_supersegments=8",
+        "sim.grid=[16,16,16]", "sim.steps_per_frame=2")
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    p1 = sess.run(2)
+    old_tf = sess.tf
+
+    # dispatch through the steering handler list (what drain_steering does
+    # for non-camera kinds)
+    msg = make_tf_message([(0.0, 0.9), (1.0, 0.9)], colormap="jet")
+    for cb in sess.on_steer:
+        cb(msg)
+    assert sess.tf is not old_tf
+    p2 = sess.run(2)
+    assert np.isfinite(p2["vdi_color"]).all()
+    # near-opaque-everywhere TF must change the render
+    assert not np.allclose(p1["vdi_color"], p2["vdi_color"])
+
+
+def test_malformed_tf_message_is_contained():
+    """A network-facing viewer sending a broken 'tf' payload must not
+    kill the render loop — logged and ignored."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.runtime.session import InSituSession
+
+    lines = []
+    cfg = FrameworkConfig().with_overrides(
+        "render.width=32", "render.height=24", "render.max_steps=24",
+        "vdi.max_supersegments=4", "composite.max_output_supersegments=4",
+        "sim.grid=[12,12,12]", "sim.steps_per_frame=1")
+    sess = InSituSession(cfg, mesh=make_mesh(2), log=lines.append)
+    tf0 = sess.tf
+    for bad in ({"type": "tf"},                              # no points
+                {"type": "tf", "points": [[0, 0]] * 40},     # too many
+                {"type": "tf", "points": [[0.1, 0.2]],
+                 "colormap": "no_such_map"}):
+        for cb in sess.on_steer:
+            cb(bad)
+    assert sess.tf is tf0                   # nothing applied
+    assert any("malformed tf" in ln for ln in lines)
+    import numpy as np
+    assert np.isfinite(sess.run(1)["vdi_color"]).all()

@@ -83,6 +83,11 @@ def save_session(sess: "InSituSession", path: str) -> None:
               for k, v in _sim_arrays(sess.sim).items()}
     for name, val in zip(_CAMERA_FIELDS, sess.camera):
         arrays[f"camera/{name}"] = np.asarray(val)
+    # the transfer function is runtime-mutable state since TF steering
+    # (apply_tf_steering): without it a resumed session would silently
+    # render with the constructor TF
+    for name, val in zip(type(sess.tf)._fields, sess.tf):
+        arrays[f"tf/{name}"] = np.asarray(val)
     for regime, thr in sess._mxu_thr.items():
         # join EVERY key part: hybrid-mode keys are ('hybrid', axis, sign)
         # and both signs of an axis must keep distinct tags
@@ -139,6 +144,32 @@ def load_session(sess: "InSituSession", path: str) -> None:
         _restore_sim(sess.sim, sim_arrays)
         sess.camera = Camera(*(jnp.asarray(z[f"camera/{n}"])
                                for n in _CAMERA_FIELDS))
+        tf_fields = type(sess.tf)._fields
+        present = [n for n in tf_fields if f"tf/{n}" in z.files]
+        if present and len(present) != len(tf_fields):
+            # some-but-not-all keys = field-set mismatch (e.g. the TF type
+            # evolved without a version bump) — silently falling back to
+            # the constructor TF would be exactly the wrong-TF resume this
+            # block exists to prevent
+            raise ValueError(
+                f"checkpoint tf/ keys {present} do not match the session "
+                f"TransferFunction fields {list(tf_fields)} — checkpoint "
+                "and session versions differ")
+        if present:
+            new_tf = type(sess.tf)(*(jnp.asarray(z[f"tf/{n}"])
+                                     for n in tf_fields))
+            changed = any(
+                not np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(new_tf, sess.tf))
+            sess.tf = new_tf
+            if changed:
+                # the restored TF differs from the constructor's: rebuild
+                # the compiled steps exactly like live TF steering does
+                # (AttributeError here is the loud failure the module
+                # promises — a session type without _build_steps cannot
+                # silently keep steps that baked the old TF in)
+                sess._build_steps()
+        # (older checkpoints have no tf/ keys: constructor TF applies)
         sess.frame_index = int(header["frame_index"])
         sess.orbit_rate = header["orbit_rate"]
         sess._mxu_thr = {}

@@ -163,3 +163,32 @@ def test_hybrid_temporal_checkpoint_roundtrip(tmp_path):
                                   np.asarray(b._mxu_thr[other].thr))
     assert not np.array_equal(np.asarray(b._mxu_thr[key].thr),
                               np.asarray(b._mxu_thr[other].thr))
+
+
+def test_steered_tf_survives_checkpoint(tmp_path):
+    """A session whose TF was changed by steering must resume with THAT
+    TF, not the constructor's — bit-exact across the round trip."""
+    from scenery_insitu_tpu.runtime.streaming import make_tf_message
+
+    path = str(tmp_path / "tf.npz")
+
+    def mk():
+        s = InSituSession(_cfg(**{"sim.grid": "[12,12,12]",
+                                  "mesh.num_devices": "2"}))
+        return s
+
+    a = mk()
+    a.run(2)
+    msg = make_tf_message([(0.0, 0.85), (1.0, 0.85)], colormap="jet")
+    for cb in a.on_steer:
+        cb(msg)
+    a.run(1)
+    save_session(a, path)
+    ref = a.run(2)
+
+    b = mk()
+    load_session(b, path)
+    np.testing.assert_array_equal(np.asarray(b.tf.alpha_m),
+                                  np.asarray(a.tf.alpha_m))
+    got = b.run(2)
+    np.testing.assert_array_equal(ref["vdi_color"], got["vdi_color"])

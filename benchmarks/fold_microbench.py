@@ -1,0 +1,337 @@
+"""Supersegment-fold schedule microbenchmark.
+
+The slice march = resampling matmuls (MXU) + a per-pixel fold
+(`ops.supersegments.push`) over the depth-ordered sample stream. The
+round-3 512^3 TPU captures put the WRITE march at ~390 ms/frame while the
+counting march costs ~34 ms — the fold schedule, not the matmuls, owns the
+frame budget (bench_tpu_r3_512.json vs bench_tpu_r3_hist.json). This
+harness times the fold alone, on synthetic streams generated on the fly
+inside the scan (so a 512-slice 640^2 stream never materializes 2.7 GB),
+for each schedule:
+
+  xla          lax.scan over chunks, C sequential ss.push per chunk
+               (ops/slicer.py generate_vdi_mxu fold="xla")
+  pallas       pm.fold_chunk per chunk (fold="pallas") — since the
+               two-phase rewrite this IS the events schedule with a
+               rolled phase 2
+  pallas_t16/32  same kernel, taller strips (monkeypatched TILE_H)
+  events       local phase-2-UNROLLED twin of the production kernel
+               (rolled-vs-unrolled phase-2 A/B; see _events_kernel)
+  count        pm.count_multi_chunk with 1 candidate — the O(1)-state
+               floor: stream generation + predicate, no K-slot writes
+  none         stream generation only (the harness overhead floor)
+
+Usage: python benchmarks/fold_microbench.py [--grid 256] [--k 16]
+       [--chunk 16] [--iters 5] [--variants xla,pallas,...]
+Prints one JSON line per variant: {"variant", "ms_per_march", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.ops import pallas_march as pm
+from scenery_insitu_tpu.ops import supersegments as ss
+
+
+def stream_chunk(ci: jnp.ndarray, c: int, h: int, w: int):
+    """Deterministic synthetic sample chunk [C,4,H,W] + t0/t1 [C,H,W].
+
+    Mimics a real generation stream: two density blobs along depth with an
+    empty gap between them (so segments start, accumulate, break on the
+    gap, and re-open), color drifting with depth (so the premultiplied-RGB
+    break metric fires at plausible rates). ~10 elementwise ops per sample
+    — negligible next to the ~120-op fold it feeds.
+    """
+    s = ci * c + jnp.arange(c, dtype=jnp.float32)          # [C]
+    jj = jnp.arange(h, dtype=jnp.float32)[:, None]         # [H,1]
+    ii = jnp.arange(w, dtype=jnp.float32)[None, :]         # [1,W]
+    # per-pixel blob centers drift across the image
+    c0 = 60.0 + 0.15 * jj + 0.05 * ii                      # [H,W]
+    c1 = c0 + 90.0
+    d0 = jnp.abs(s[:, None, None] - c0[None])              # [C,H,W]
+    d1 = jnp.abs(s[:, None, None] - c1[None])
+    alpha = jnp.maximum(jnp.maximum(0.0, 0.9 - d0 * 0.03),
+                        jnp.maximum(0.0, 0.7 - d1 * 0.025))
+    shade = 0.5 + 0.5 * jnp.sin(s * 0.21)[:, None, None]
+    rgba = jnp.stack([alpha * shade, alpha * (1.0 - shade),
+                      alpha * 0.3, alpha], axis=1)         # [C,4,H,W]
+    t0 = (s[:, None, None] + 0.0) * 0.01 + jj[None] * 0.0 + ii[None] * 0.0
+    t0 = jnp.broadcast_to(t0, (c, h, w))
+    t1 = t0 + 0.01
+    return rgba, t0, t1
+
+
+def _events_kernel(rgba_ref, td_ref, thr_ref,
+                   ci_, di_, smi_, co, do_, smo, *, max_k: int):
+    """Phase-2-UNROLLED twin of the production two-phase fold.
+
+    This prototype was promoted into pm._fold_kernel (which replaced the
+    original per-slice load/store schedule after the 2026-07-30 512^3
+    captures showed it at ~390 ms/march). The production kernel rolls
+    phase 2 over K with a fori_loop + dynamic ref writes to keep the
+    kernel graph small; this copy keeps the fully-unrolled K×C phase 2,
+    so '--variants pallas,events' A/Bs rolled vs unrolled phase-2
+    lowering on hardware. It deliberately omits count/gap_eps support;
+    if ops/supersegments.py semantics change, update both (the --check
+    mode and tests/test_pallas_march.py catch drift).
+
+    State packing (small): smi_/smo f32[12, TH, W] =
+      seg_rgba[0:4], seg_start[4], seg_end[5], prev_rgb[6:9],
+      open[9], prev_empty[10], k[11] (f32-encoded count).
+    Big state: ci_/co color [K,4,TH,W]; di_/do_ depth [K,2,TH,W].
+    """
+    nc = rgba_ref.shape[0]
+    thr = thr_ref[...]
+    sm = smi_[...]
+    seg_rgba = sm[0:4]
+    seg_start, seg_end = sm[4], sm[5]
+    prev_rgb = sm[6:9]
+    open_ = sm[9] > 0.5
+    prev_empty = sm[10] > 0.5
+    kcnt = sm[11]
+
+    ev = []                                   # per-slice close records
+    for i in range(nc):
+        rgba = rgba_ref[i]
+        t0 = td_ref[i, 0]
+        t1 = td_ref[i, 1]
+        is_empty = rgba[3] < ss.EMPTY_ALPHA
+        d = rgba[:3] - prev_rgb
+        diff = jnp.sqrt(jnp.sum(d * d, axis=0))
+        want_break = ((~is_empty & ~prev_empty & (diff > thr))
+                      | (is_empty & ~prev_empty))
+        do_close = open_ & want_break & (kcnt < max_k - 1)
+        # record the close event; slot = kcnt at close time, else -1
+        ev.append((jnp.where(do_close, kcnt, -1.0),
+                   jnp.where(do_close[None], seg_rgba, 0.0),
+                   jnp.where(do_close, seg_start, 0.0),
+                   jnp.where(do_close, seg_end, 0.0)))
+        kcnt = jnp.where(do_close, kcnt + 1.0, kcnt)
+        open_ = open_ & ~do_close
+        start_new = ~is_empty & ~open_
+        accumulate = ~is_empty & open_
+        seg_rgba = jnp.where(start_new[None], rgba,
+                             jnp.where(accumulate[None],
+                                       seg_rgba + (1.0 - seg_rgba[3:4])
+                                       * rgba, seg_rgba))
+        seg_start = jnp.where(start_new, t0, seg_start)
+        seg_end = jnp.where(start_new | accumulate, t1, seg_end)
+        open_ = open_ | start_new
+        prev_rgb = jnp.where(is_empty[None], prev_rgb, rgba[:3])
+        prev_empty = is_empty
+
+    smo[...] = jnp.concatenate([
+        seg_rgba, seg_start[None], seg_end[None], prev_rgb,
+        open_.astype(jnp.float32)[None],
+        prev_empty.astype(jnp.float32)[None], kcnt[None]])
+
+    # phase 2: fold events into the K-state, one slot row at a time
+    for kk in range(max_k):
+        hit = None
+        acc_c = None
+        acc_s = None
+        acc_e = None
+        for slot, c_rgba, c_s, c_e in ev:
+            m = slot == kk                     # [TH, W] bool
+            mf = m.astype(jnp.float32)
+            hit = m if hit is None else (hit | m)
+            acc_c = c_rgba * mf[None] if acc_c is None \
+                else acc_c + c_rgba * mf[None]
+            acc_s = c_s * mf if acc_s is None else acc_s + c_s * mf
+            acc_e = c_e * mf if acc_e is None else acc_e + c_e * mf
+        # a slot is closed at most once over the whole march, so + is a
+        # select; start/end need where (init is +inf, not 0)
+        co[kk] = ci_[kk] + acc_c
+        do_[kk, 0] = jnp.where(hit, acc_s, di_[kk, 0])
+        do_[kk, 1] = jnp.where(hit, acc_e, di_[kk, 1])
+
+
+def events_fold_chunk(big, small, rgba, t0, t1, threshold, *, max_k: int,
+                      tile_h: int = 8):
+    """Driver for `_events_kernel`: big = (color [K,4,H,W], depth
+    [K,2,H,W]), small = f32[12,H,W] (see kernel docstring)."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    color, depth = big
+    _, _, h, w = color.shape
+    c = rgba.shape[0]
+    threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
+    td = jnp.stack([t0, t1], axis=1)
+    row = lambda *lead: pl.BlockSpec(lead + (tile_h, w),
+                                     lambda j: (0,) * len(lead) + (j, 0))
+    kk = color.shape[0]
+    out = pl.pallas_call(
+        functools.partial(_events_kernel, max_k=max_k),
+        grid=(h // tile_h,),
+        in_specs=[row(c, 4), row(c, 2), row(),
+                  row(kk, 4), row(kk, 2), row(12)],
+        out_specs=[row(kk, 4), row(kk, 2), row(12)],
+        out_shape=[jax.ShapeDtypeStruct(color.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(depth.shape, jnp.float32),
+                   jax.ShapeDtypeStruct((12, h, w), jnp.float32)],
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=pm.should_interpret(),
+    )(rgba, td, threshold, color, depth, small)
+    return (out[0], out[1]), out[2]
+
+
+def events_init(k: int, h: int, w: int):
+    color = jnp.zeros((k, 4, h, w), jnp.float32)
+    depth = jnp.full((k, 2, h, w), jnp.inf, jnp.float32)
+    small = jnp.zeros((12, h, w), jnp.float32)
+    small = small.at[10].set(1.0)             # prev_empty = True
+    return (color, depth), small
+
+
+def events_finalize(big, small):
+    """Close the trailing open segment exactly like ss.finalize."""
+    color, depth = big
+    st = ss.SegState(
+        out_color=color, out_start=depth[:, 0], out_end=depth[:, 1],
+        k=small[11].astype(jnp.int32), open_=small[9] > 0.5,
+        seg_rgba=small[0:4], seg_start=small[4], seg_end=small[5],
+        prev_rgb=small[6:9], prev_empty=small[10] > 0.5)
+    return ss.finalize(st)
+
+
+def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
+    nchunks = s_total // c
+    thr = jnp.full((h, w), 0.35, jnp.float32)
+
+    if variant == "xla":
+        def run():
+            def body(st, ci):
+                rgba, t0, t1 = stream_chunk(ci, c, h, w)
+                for i in range(c):
+                    st = ss.push(st, k, thr, rgba[i], t0[i], t1[i])
+                return st, None
+            st, _ = jax.lax.scan(body, ss.init_state(k, h, w),
+                                 jnp.arange(nchunks))
+            return ss.finalize(st)
+    elif variant.startswith("pallas"):
+        tile = int(variant[8:]) if len(variant) > 6 else None
+
+        def run():
+            old = pm.TILE_H
+            if tile is not None:
+                pm.TILE_H = tile
+            try:
+                def body(packed, ci):
+                    rgba, t0, t1 = stream_chunk(ci, c, h, w)
+                    return pm.fold_chunk(packed, rgba, t0, t1, thr,
+                                         max_k=k), None
+                packed, _ = jax.lax.scan(body, pm.init_packed(k, h, w),
+                                         jnp.arange(nchunks))
+                return ss.finalize(pm.unpack_state(packed))
+            finally:
+                pm.TILE_H = old
+    elif variant == "events":
+        def run():
+            def body(carry, ci):
+                big, small = carry
+                rgba, t0, t1 = stream_chunk(ci, c, h, w)
+                return events_fold_chunk(big, small, rgba, t0, t1, thr,
+                                         max_k=k), None
+            carry, _ = jax.lax.scan(body, events_init(k, h, w),
+                                    jnp.arange(nchunks))
+            return events_finalize(*carry)
+    elif variant == "count":
+        def run():
+            def body(carry, ci):
+                rgba, _, _ = stream_chunk(ci, c, h, w)
+                return pm.count_multi_chunk(carry, rgba, [0.35]), None
+            carry, _ = jax.lax.scan(body,
+                                    pm.init_count_multi_packed(1, h, w),
+                                    jnp.arange(nchunks))
+            return carry[0]
+    elif variant == "none":
+        def run():
+            def body(acc, ci):
+                rgba, t0, t1 = stream_chunk(ci, c, h, w)
+                return acc + rgba.sum(0) + (t0.sum(0) + t1.sum(0))[None], None
+            acc, _ = jax.lax.scan(body, jnp.zeros((4, h, w)),
+                                  jnp.arange(nchunks))
+            return acc
+    else:
+        raise ValueError(f"unknown variant {variant!r}")
+    return run
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", type=int, default=256,
+                    help="slices S; H=W=grid*1.25 (the 512->640 ratio)")
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--variants", default="none,count,xla,pallas")
+    ap.add_argument("--check", action="store_true",
+                    help="assert events/pallas outputs match the xla fold "
+                    "on this stream before timing")
+    args = ap.parse_args()
+
+    if os.environ.get("SITPU_CPU") == "1":
+        # JAX_PLATFORMS=cpu alone does not stop the axon TPU shim
+        from scenery_insitu_tpu.utils.backend import pin_cpu_backend
+        pin_cpu_backend()
+
+    s_total = args.grid
+    h = w = args.grid * 5 // 4
+    h = -(-h // 32) * 32  # keep every TILE_H variant happy
+    w = h
+    dev = jax.devices()[0]
+    print(f"[fold_microbench] {dev.platform} {dev.device_kind} "
+          f"S={s_total} HxW={h}x{w} K={args.k} C={args.chunk}",
+          file=sys.stderr, flush=True)
+
+    if args.check:
+        import numpy as np
+        ref = jax.jit(build("xla", s_total, args.chunk, args.k, h, w))()
+        for v in ("pallas", "events"):
+            got = jax.jit(build(v, s_total, args.chunk, args.k, h, w))()
+            for a, b, name in [(ref[0], got[0], "color"),
+                               (ref[1], got[1], "depth")]:
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-5,
+                                           err_msg=f"{v} {name}")
+        print("[fold_microbench] parity check passed (pallas, events)",
+              file=sys.stderr, flush=True)
+
+    for variant in args.variants.split(","):
+        variant = variant.strip()
+        try:
+            run = jax.jit(build(variant, s_total, args.chunk, args.k, h, w))
+            t_c = time.perf_counter()
+            out = run()
+            jax.block_until_ready(out)
+            compile_s = time.perf_counter() - t_c
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = run()
+            jax.block_until_ready(out)
+            ms = (time.perf_counter() - t0) / args.iters * 1e3
+            print(json.dumps({
+                "variant": variant, "ms_per_march": round(ms, 2),
+                "compile_s": round(compile_s, 1),
+                "grid": s_total, "hw": [h, w], "k": args.k,
+                "chunk": args.chunk, "platform": dev.platform,
+            }), flush=True)
+        except Exception as e:  # keep the sweep alive past one bad variant
+            print(json.dumps({"variant": variant,
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

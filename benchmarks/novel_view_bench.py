@@ -72,33 +72,39 @@ def main():
               file=sys.stderr, flush=True)
         return dt
 
+    # the VDI / proxy volume ride as jit ARGUMENTS, not closures: a closed-
+    # over array is baked into the HLO as a literal constant, and this
+    # environment's axon shim ships the serialized program to a remote
+    # compile service — a 256^3 proxy constant (268 MB) exceeds its request
+    # limit (HTTP 413) before compilation even starts
     regime = slicer.choose_axis(cam0)      # host-side; yaw stays in-regime
-    mxu = jax.jit(lambda yaw: render_vdi_mxu(
-        vdi, axcam, spec, orbit(cam0, yaw), args.width, args.height,
+    mxu_j = jax.jit(lambda v, ac, yaw: render_vdi_mxu(
+        v, ac, spec, orbit(cam0, yaw), args.width, args.height,
         num_slices=g, axis_sign=regime))
-    t_mxu = timed(mxu, "mxu plane sweep")
+    t_mxu = timed(lambda yaw: mxu_j(vdi, axcam, yaw), "mxu plane sweep")
 
     # cross-regime: a view marching a different axis goes through the
     # pre-shaded proxy volume — built ONCE per VDI, reused per view
     from scenery_insitu_tpu.ops.vdi_novel import (render_vdi_any,
                                                   vdi_to_rgba_volume)
-    proxy = jax.jit(lambda: vdi_to_rgba_volume(vdi, axcam, spec,
-                                               num_slices=g))()
+    proxy = jax.jit(lambda v, ac: vdi_to_rgba_volume(
+        v, ac, spec, num_slices=g))(vdi, axcam)
     jax.block_until_ready(proxy.data)
     cam_x = Camera.create((2.9, 0.2, 0.3), fov_y_deg=45.0, near=0.3,
                           far=10.0)
     regime_x = slicer.choose_axis(cam_x)
-    cross = jax.jit(lambda yaw: render_vdi_any(
-        vdi, axcam, spec, orbit(cam_x, yaw), args.width, args.height,
-        num_slices=g, axis_sign=regime_x, proxy=proxy))
-    t_cross = timed(cross, "cross-regime proxy")
+    cross_j = jax.jit(lambda v, ac, p, yaw: render_vdi_any(
+        v, ac, spec, orbit(cam_x, yaw), args.width, args.height,
+        num_slices=g, axis_sign=regime_x, proxy=p))
+    t_cross = timed(lambda yaw: cross_j(vdi, axcam, proxy, yaw),
+                    "cross-regime proxy")
 
     t_gather = None
     if not args.skip_gather:
-        gather = jax.jit(lambda yaw: render_vdi(
-            vdi, meta, orbit(cam0, yaw), args.width, args.height,
+        gather_j = jax.jit(lambda v, yaw: render_vdi(
+            v, meta, orbit(cam0, yaw), args.width, args.height,
             steps=args.gather_steps))
-        t_gather = timed(gather, "gather per-step")
+        t_gather = timed(lambda yaw: gather_j(vdi, yaw), "gather per-step")
 
     print(json.dumps({
         "metric": f"novel_view_{g}c_{args.width}x{args.height}_ms",

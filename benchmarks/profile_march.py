@@ -21,7 +21,7 @@ def timeit(fn, *args, n=3, label=""):
         out = fn(*args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / n * 1000
-    print(f"{label:42s} {dt:9.1f} ms")
+    print(f"{label:42s} {dt:9.1f} ms", flush=True)
     return dt
 
 
@@ -43,7 +43,8 @@ def main():
     cam = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
     spec = slicer.make_spec(cam, (grid, grid, grid), SliceMarchConfig())
     print(f"grid={grid} spec ni={spec.ni} nj={spec.nj} chunk={spec.chunk} "
-          f"dtype={spec.matmul_dtype} backend={jax.default_backend()}")
+          f"dtype={spec.matmul_dtype} fold={spec.fold} "
+          f"backend={jax.default_backend()}", flush=True)
 
     st = gs.GrayScott.init((grid, grid, grid))
     st = gs.multi_step(st, 30)

@@ -1,0 +1,86 @@
+#!/bin/bash
+# Round-3 second-window watcher. The first window (22:12-22:48 UTC) captured
+# the 512^3/256^3/histogram flagship numbers and exposed the write-fold as
+# the bottleneck (~390 of 420 ms/frame at 512^3); the tunnel died before the
+# diagnostics ran. This suite is ordered by marginal value for the NEXT
+# window:
+#   1. fold_microbench      - decides the fold schedule (new two-phase
+#                             Pallas kernel vs XLA scan vs counting floor)
+#   2. bench 512 (new fold) - flagship number with the rewritten kernel
+#   3. bench 512 fold=xla   - the schedule comparison at primary scale
+#   4. novel-view bench     - re-run with the HLO-constant fix (HTTP 413)
+#   5. composite bench      - re-run with the 1-chip rank clamp
+#   6. profile_march        - per-stage march breakdown (now line-buffered)
+#   7. profile_frame        - xprof steady-state trace
+#   8. scaling sweep        - 1-chip strong-scaling row
+# Every step has a hard timeout; JSON-validated steps keep output only when
+# it parses. Log: /tmp/tpu_watcher_r3b.log
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p benchmarks/results
+R=benchmarks/results
+L=/tmp/tpu_watcher_r3b.log
+step() {  # step <outfile> <timeout_s> <cmd...>
+  local out="$1" tmo="$2"; shift 2
+  if timeout "$tmo" "$@" 2>>"$L" | tail -1 > "$out.tmp" \
+     && python -c "import json,sys; json.load(open(sys.argv[1]))" "$out.tmp" \
+        2>>"$L"; then
+    mv "$out.tmp" "$out"; echo "ok: $out" >> "$L"
+  else
+    rm -f "$out.tmp"; echo "FAILED: $out" >> "$L"
+  fi
+}
+for i in $(seq 1 200); do
+  if timeout 120 python -c "
+import jax
+assert jax.devices()[0].platform == 'tpu'
+import jax.numpy as jnp
+assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) > 0
+" 2>/dev/null; then
+    echo "tunnel alive at $(date -u) attempt $i" | tee -a "$L"
+    date -u >> "$R/tpu_alive_r3.marker"
+    if timeout 2400 python benchmarks/fold_microbench.py --grid 256 \
+         --iters 3 --variants none,count,xla,pallas \
+         > "$R/fold_microbench_tpu_r3.jsonl.tmp" 2>>"$L"; then
+      mv "$R/fold_microbench_tpu_r3.jsonl.tmp" "$R/fold_microbench_tpu_r3.jsonl"
+      echo "ok: fold_microbench" >> "$L"
+      cat "$R/fold_microbench_tpu_r3.jsonl"
+    else
+      rm -f "$R/fold_microbench_tpu_r3.jsonl.tmp"
+      echo "FAILED: fold_microbench" >> "$L"
+    fi
+    step "$R/bench_tpu_r3_512_newfold.json" 4000 env \
+      SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=1700 \
+      python bench.py
+    cat "$R/bench_tpu_r3_512_newfold.json" 2>/dev/null
+    step "$R/bench_tpu_r3_512_xlafold.json" 2100 env \
+      SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=xla \
+      SITPU_BENCH_CHILD_TIMEOUT=1700 python bench.py
+    cat "$R/bench_tpu_r3_512_xlafold.json" 2>/dev/null
+    step "$R/bench_tpu_r3_256_newfold.json" 2400 env SITPU_BENCH_GRID=256 \
+      SITPU_BENCH_PLATFORMS=tpu,tpu python bench.py
+    step "$R/novel_view_tpu_r3.json" 1500 \
+      python benchmarks/novel_view_bench.py --iters 3
+    step "$R/composite_tpu_r3.json" 1200 env SITPU_BENCH_REAL=1 \
+      python benchmarks/composite_bench.py
+    if timeout 1500 python -u benchmarks/profile_march.py 256 \
+         2>>"$L" > "$R/profile_march_tpu_r3.txt.tmp"; then
+      mv "$R/profile_march_tpu_r3.txt.tmp" "$R/profile_march_tpu_r3.txt"
+      echo "ok: profile_march" >> "$L"
+    else
+      # keep partial output: the per-stage lines stream now, and even a
+      # truncated breakdown is evidence
+      mv "$R/profile_march_tpu_r3.txt.tmp" \
+         "$R/profile_march_tpu_r3_partial.txt" 2>/dev/null
+      echo "FAILED: profile_march (partial kept)" >> "$L"
+    fi
+    step "$R/profile_frame_tpu_r3.json" 1200 \
+      python benchmarks/profile_frame.py --out "$R/trace_r3"
+    step "$R/scaling_tpu_r3.json" 1800 env SITPU_BENCH_REAL=1 \
+      python benchmarks/scaling_bench.py --grid 128 --frames 10
+    echo "suite done at $(date -u)" >> "$L"
+    exit 0
+  fi
+  sleep 120
+done
+echo "tunnel never returned" >> "$L"
+exit 1

@@ -8,32 +8,42 @@ The XLA march (ops/slicer.slice_march + ops/supersegments.push) carries the
 full ``SegState`` — ~107 floats per pixel, dominated by ``out_color
 [K,4,H,W]`` — through a ``lax.scan``, and every per-slice ``push`` inside
 the scan body reads and rewrites those full-frame tensors through HBM.
-Profiling put that write fold at ~40% of generation and matmul MFU at 0.8%:
-the march is fold-bandwidth-bound, not MXU-bound.
 
-These kernels keep the resampling einsum in XLA (it IS the MXU work) and
-run the fold over VMEM-resident pixel tiles instead:
+The first fused kernel (round 3, commit 2358581) moved that fold onto VMEM
+pixel strips but kept the XLA fold's schedule: per SLICE, load the whole
+packed K-state from the VMEM refs, run ``ss.push`` (whose ``_write`` does
+an O(K) one-hot select over every [K,...] array), store the whole state
+back. On real hardware that was a regression — the 2026-07-30 TPU captures
+(benchmarks/results/bench_tpu_r3_*.json) put the write march at ~390 ms at
+512^3 vs ~34 ms for the O(1)-state counting march: ~100 floats/pixel of
+VMEM state round-tripped per slice drowns the ~30-op state machine.
 
-- `fold_chunk`: feed one chunk of C depth-ordered slices through the
-  writer state machine (`ss.push`), one kernel launch per chunk. State
-  enters and leaves the kernel once per CHUNK instead of per slice, and
-  the C pushes in between touch only VMEM. Optionally counts true segment
-  starts in the same pass (the temporal controller's feedback signal —
-  `ss.push_count` shares the writer's prev-item stream, so the count is
-  free here, where the XLA path folds a separate CountState).
-- `count_multi_chunk`: the histogram counting march — evaluates every
-  candidate threshold simultaneously (`ss.init_count_multi` semantics)
-  on the VMEM tile; candidates are compile-time constants.
+This kernel therefore splits the fold into two phases with the K-state
+touched ONCE per chunk (benchmarks/fold_microbench.py measures the
+schedules side by side):
 
-Both kernels call the exact `ops.supersegments` fold functions the XLA
-path uses — one implementation of the semantics, two schedules — so
-tests/test_pallas_march.py asserts exact equality, chunk by chunk.
+- **Phase 1** unrolls the C-slice loop with the O(1) segment machine
+  (open-segment RGBA/extent, prev-item, slot counter — 12 floats/pixel)
+  carried as SSA values (registers; Mosaic spills what doesn't fit), and
+  records each slice's potential close event (slot, rgba, t0, t1) as
+  values. The optional temporal start-count accumulates here for free —
+  it shares the writer's own prev-item stream exactly like the XLA
+  ``ss.push_count`` twin.
+- **Phase 2** loops over the K output slots; each slot row sums its (at
+  most one — slots close at most once per march, the counter only moves
+  forward) matching event from the C records and merges with the incoming
+  row. [K,...] state: one read + one write per chunk.
 
-State is packed into 7 arrays (bool → f32 flags, as in pallas_composite):
-``color [K,4,H,W], depth [K,2,H,W], seg [4,H,W], segse [2,H,W],
-prev [3,H,W], flags [2,H,W] (open_, prev_empty), k i32[H,W]``.
-``input_output_aliases`` pins each state input to its output so XLA can
-update in place.
+Both phases implement exactly ``ss.push``'s semantics (same predicates,
+same merge-overflow into the last slot); tests/test_pallas_march.py and
+the committed golden fixture (tests/test_golden.py) pin equality with the
+XLA fold chunk by chunk.
+
+State is packed into 3 arrays: ``color f32[K,4,H,W]``, ``depth
+f32[K,2,H,W]`` (start/end in [:,0]/[:,1]), and ``small f32[12,H,W]`` =
+seg_rgba[0:4], seg_start[4], seg_end[5], prev_rgb[6:9], open[9],
+prev_empty[10], k-count[11] (f32-encoded). ``input_output_aliases`` pins
+each state input to its output so XLA updates in place.
 
 Tiling: (8, W) strips — 8 sublanes × the full row width, grid over H/8.
 W needn't be a multiple of 128: a strip is the whole (only) block of its
@@ -45,7 +55,7 @@ On CPU (tests, the virtual mesh) the kernels run in interpret mode.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,8 +64,14 @@ from jax.experimental import pallas as pl
 from scenery_insitu_tpu.ops import supersegments as ss
 from scenery_insitu_tpu.ops.pallas_util import TILE_H, should_interpret
 
-# packed-state field count; see pack_state
-_STATE_FIELDS = 7
+# packed-state arrays: color, depth, small
+_STATE_FIELDS = 3
+# small-state rows
+_SEG_RGBA = slice(0, 4)
+_SEG_START, _SEG_END = 4, 5
+_PREV_RGB = slice(6, 9)
+_OPEN, _PREV_EMPTY, _K = 9, 10, 11
+_NSMALL = 12
 
 
 # ------------------------------------------------------------- state packing
@@ -63,28 +79,34 @@ _STATE_FIELDS = 7
 
 def init_packed(k: int, height: int, width: int):
     """Packed fold state ≅ ss.init_state(k, height, width)."""
-    return pack_state(ss.init_state(k, height, width))
+    color = jnp.zeros((k, 4, height, width), jnp.float32)
+    depth = jnp.full((k, 2, height, width), jnp.inf, jnp.float32)
+    small = jnp.zeros((_NSMALL, height, width), jnp.float32)
+    small = small.at[_PREV_EMPTY].set(1.0)
+    return (color, depth, small)
 
 
 def pack_state(st: ss.SegState):
-    flags = jnp.stack([st.open_.astype(jnp.float32),
-                       st.prev_empty.astype(jnp.float32)])
+    small = jnp.concatenate([
+        st.seg_rgba,
+        st.seg_start[None], st.seg_end[None],
+        st.prev_rgb,
+        st.open_.astype(jnp.float32)[None],
+        st.prev_empty.astype(jnp.float32)[None],
+        st.k.astype(jnp.float32)[None]])
     return (st.out_color,
             jnp.stack([st.out_start, st.out_end], axis=1),
-            st.seg_rgba,
-            jnp.stack([st.seg_start, st.seg_end]),
-            st.prev_rgb,
-            flags,
-            st.k)
+            small)
 
 
 def unpack_state(packed) -> ss.SegState:
-    color, depth, seg, segse, prev, flags, k = packed
+    color, depth, small = packed
     return ss.SegState(
         out_color=color, out_start=depth[:, 0], out_end=depth[:, 1],
-        k=k, open_=flags[0] > 0.5, seg_rgba=seg,
-        seg_start=segse[0], seg_end=segse[1],
-        prev_rgb=prev, prev_empty=flags[1] > 0.5)
+        k=small[_K].astype(jnp.int32), open_=small[_OPEN] > 0.5,
+        seg_rgba=small[_SEG_RGBA],
+        seg_start=small[_SEG_START], seg_end=small[_SEG_END],
+        prev_rgb=small[_PREV_RGB], prev_empty=small[_PREV_EMPTY] > 0.5)
 
 
 # ------------------------------------------------------------ write(+count)
@@ -93,62 +115,99 @@ def unpack_state(packed) -> ss.SegState:
 def _fold_kernel(*refs, max_k: int, gap_eps: float, with_count: bool):
     if with_count:
         (rgba_ref, td_ref, thr_ref,
-         ci, di, si, ssei, pi, fi, ki, cnt_i,
-         co, do_, so, sseo, po, fo, ko, cnt_o) = refs
+         ci_, di_, smi_, cnt_i,
+         co, do_, smo, cnt_o) = refs
     else:
         (rgba_ref, td_ref, thr_ref,
-         ci, di, si, ssei, pi, fi, ki,
-         co, do_, so, sseo, po, fo, ko) = refs
+         ci_, di_, smi_,
+         co, do_, smo) = refs
         cnt_i = cnt_o = None
     nc = rgba_ref.shape[0]
     thr = thr_ref[...]
 
-    # working state lives in the OUTPUT refs (VMEM blocks): seed from the
-    # inputs once, fold all C slices, leave the result in place. The
-    # fori_loop carries nothing — Mosaic cannot legalize a loop with a
-    # dozen carried vectors (see pallas_composite._kernel).
-    co[...] = ci[...]
-    do_[...] = di[...]
-    so[...] = si[...]
-    sseo[...] = ssei[...]
-    po[...] = pi[...]
-    fo[...] = fi[...]
-    ko[...] = ki[...]
-    if with_count:
-        cnt_o[...] = cnt_i[...]
+    # ---- phase 1: O(1) machine over the C slices, state in SSA values
+    sm = smi_[...]
+    seg_rgba = sm[_SEG_RGBA]
+    seg_start, seg_end = sm[_SEG_START], sm[_SEG_END]
+    prev_rgb = sm[_PREV_RGB]
+    open_ = sm[_OPEN] > 0.5
+    prev_empty = sm[_PREV_EMPTY] > 0.5
+    kcnt = sm[_K]
+    n_starts = None
 
-    def load() -> ss.SegState:
-        return ss.SegState(
-            out_color=co[...], out_start=do_[:, 0], out_end=do_[:, 1],
-            k=ko[...], open_=fo[0] > 0.5, seg_rgba=so[...],
-            seg_start=sseo[0], seg_end=sseo[1],
-            prev_rgb=po[...], prev_empty=fo[1] > 0.5)
-
-    def store(st: ss.SegState) -> None:
-        co[...] = st.out_color
-        do_[:, 0] = st.out_start
-        do_[:, 1] = st.out_end
-        so[...] = st.seg_rgba
-        sseo[0] = st.seg_start
-        sseo[1] = st.seg_end
-        po[...] = st.prev_rgb
-        fo[0] = st.open_.astype(jnp.float32)
-        fo[1] = st.prev_empty.astype(jnp.float32)
-        ko[...] = st.k
-
-    def body(i, _):
-        st = load()
+    events = []                        # (slot f32, rgba [4], t0, t1)
+    for i in range(nc):
+        rgba = rgba_ref[i]
+        t0 = td_ref[i, 0]
+        t1 = td_ref[i, 1]
+        is_empty = rgba[3] < ss.EMPTY_ALPHA
+        d = rgba[:3] - prev_rgb
+        diff = jnp.sqrt(jnp.sum(d * d, axis=0))
+        break_metric = ~is_empty & ~prev_empty & (diff > thr)
+        want_break = break_metric | (is_empty & ~prev_empty)
+        if gap_eps >= 0.0:
+            want_break |= ~is_empty & open_ & (t0 > seg_end + gap_eps)
+        do_close = open_ & want_break & (kcnt < max_k - 1)
         if with_count:
-            # true (uncapped) segment starts — ss.push_count's predicate on
-            # the writer's own prev-item stream (identical tracking rules)
-            starts, _ = ss._start_mask(st.prev_rgb, st.prev_empty, None,
-                                       rgba_ref[i], thr, None, -1.0)
-            cnt_o[...] = cnt_o[...] + starts.astype(jnp.int32)
-        store(ss.push(st, max_k, thr, rgba_ref[i],
-                      td_ref[i, 0], td_ref[i, 1], gap_eps))
+            # TRUE segment starts at this threshold (temporal feedback):
+            # ss.push_count's predicate on the writer's prev-item stream
+            starts = ~is_empty & (prev_empty | (diff > thr))
+            sf = starts.astype(jnp.float32)
+            n_starts = sf if n_starts is None else n_starts + sf
+        events.append((jnp.where(do_close, kcnt, -1.0),
+                       jnp.where(do_close[None], seg_rgba, 0.0),
+                       jnp.where(do_close, seg_start, 0.0),
+                       jnp.where(do_close, seg_end, 0.0)))
+        kcnt = jnp.where(do_close, kcnt + 1.0, kcnt)
+        open_ = open_ & ~do_close
+        start_new = ~is_empty & ~open_
+        accumulate = ~is_empty & open_
+        seg_rgba = jnp.where(
+            start_new[None], rgba,
+            jnp.where(accumulate[None],
+                      seg_rgba + (1.0 - seg_rgba[3:4]) * rgba, seg_rgba))
+        seg_start = jnp.where(start_new, t0, seg_start)
+        seg_end = jnp.where(start_new | accumulate, t1, seg_end)
+        open_ = open_ | start_new
+        prev_rgb = jnp.where(is_empty[None], prev_rgb, rgba[:3])
+        prev_empty = is_empty
+
+    smo[...] = jnp.concatenate([
+        seg_rgba, seg_start[None], seg_end[None], prev_rgb,
+        open_.astype(jnp.float32)[None],
+        prev_empty.astype(jnp.float32)[None], kcnt[None]])
+    if with_count:
+        cnt_o[...] = cnt_i[...] + n_starts.astype(jnp.int32)
+
+    # ---- phase 2: per-slot event extraction; K-state touched once.
+    # Rolled over K (the event arrays are loop-INVARIANT captures — only
+    # carried state breaks Mosaic legalization) so the kernel graph stays
+    # small: the unrolled K×C version compiled ~4× slower everywhere and
+    # dominated interpret-mode test time.
+    ev_slot = jnp.stack([e[0] for e in events])            # [C, TH, W]
+    ev_rgba = jnp.stack([e[1] for e in events])            # [C, 4, TH, W]
+    ev_s = jnp.stack([e[2] for e in events])               # [C, TH, W]
+    ev_e = jnp.stack([e[3] for e in events])               # [C, TH, W]
+
+    def slot_body(kk, _):
+        m = ev_slot == kk.astype(jnp.float32)
+        mf = m.astype(jnp.float32)
+        hit = jnp.any(m, axis=0)
+        acc_c = jnp.sum(ev_rgba * mf[:, None], axis=0)
+        acc_s = jnp.sum(ev_s * mf, axis=0)
+        acc_e = jnp.sum(ev_e * mf, axis=0)
+        # + is a select: a slot closes at most once over the whole march
+        # (the counter only moves forward), and color rows start at 0;
+        # depth rows start at +inf so they need the explicit where
+        co[pl.dslice(kk, 1)] = (ci_[pl.dslice(kk, 1)]
+                                + acc_c[None].astype(jnp.float32))
+        drow = di_[pl.dslice(kk, 1)]
+        do_[pl.dslice(kk, 1)] = jnp.stack(
+            [jnp.where(hit, acc_s, drow[0, 0]),
+             jnp.where(hit, acc_e, drow[0, 1])])[None]
         return 0
 
-    jax.lax.fori_loop(0, nc, body, 0)
+    jax.lax.fori_loop(0, max_k, slot_body, 0)
 
 
 def fold_chunk(packed, rgba: jnp.ndarray, t0: jnp.ndarray, t1: jnp.ndarray,
@@ -157,17 +216,19 @@ def fold_chunk(packed, rgba: jnp.ndarray, t0: jnp.ndarray, t1: jnp.ndarray,
                interpret: Optional[bool] = None):
     """Fold one chunk of slices through the writer machine on pixel strips.
 
-    packed: `pack_state` tuple ([K,…,H,W] / […,H,W]); rgba f32[C,4,H,W]
-    premultiplied; t0/t1 f32[C,H,W]; threshold f32[H,W] (or scalar).
-    ``count`` (i32[H,W], optional) additionally accumulates TRUE segment
-    starts at this threshold (the temporal controller's signal). Returns
-    the updated packed state (and count when given) — bit-identical to C
-    sequential ``ss.push``/``ss.push_count`` calls.
+    packed: `pack_state` triple (color [K,4,H,W], depth [K,2,H,W], small
+    [12,H,W]); rgba f32[C,4,H,W] premultiplied; t0/t1 f32[C,H,W];
+    threshold f32[H,W] (or scalar). ``count`` (i32[H,W], optional)
+    additionally accumulates TRUE segment starts at this threshold (the
+    temporal controller's signal). Returns the updated packed state (and
+    count when given) — bit-identical to C sequential
+    ``ss.push``/``ss.push_count`` calls.
     """
     if interpret is None:
         interpret = should_interpret()
-    color = packed[0]
-    kk, _, h, w = color.shape
+    color, depth, small = packed
+    kk = color.shape[0]
+    _, _, h, w = color.shape
     c = rgba.shape[0]
     if h % TILE_H:
         raise ValueError(f"height {h} not a multiple of {TILE_H}")
@@ -178,8 +239,7 @@ def fold_chunk(packed, rgba: jnp.ndarray, t0: jnp.ndarray, t1: jnp.ndarray,
     grid = (h // TILE_H,)
     row = lambda *lead: pl.BlockSpec(lead + (TILE_H, w),
                                      lambda j: (0,) * len(lead) + (j, 0))
-    state_specs = [row(kk, 4), row(kk, 2), row(4), row(2), row(3), row(2),
-                   row()]
+    state_specs = [row(kk, 4), row(kk, 2), row(_NSMALL)]
     state_shapes = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in packed]
     in_specs = [row(c, 4), row(c, 2), row()] + list(state_specs)
     out_specs = list(state_specs)
@@ -297,11 +357,7 @@ def fold_compile_ok(max_k: int = 32, chunk: int = 16,
             sds = jax.ShapeDtypeStruct
             packed = (sds((k, 4, h, w), jnp.float32),
                       sds((k, 2, h, w), jnp.float32),
-                      sds((4, h, w), jnp.float32),
-                      sds((2, h, w), jnp.float32),
-                      sds((3, h, w), jnp.float32),
-                      sds((2, h, w), jnp.float32),
-                      sds((h, w), jnp.int32))
+                      sds((_NSMALL, h, w), jnp.float32))
 
             def f(packed, rgba, t0, t1, thr, count):
                 return fold_chunk(packed, rgba, t0, t1, thr, max_k=k,

@@ -75,36 +75,41 @@ def main(argv=None):
         cfg = SliceMarchConfig()
         compiled = {}
 
+        # the volume rides as a jit ARGUMENT: a closed-over array bakes
+        # into the HLO as a literal, and a >=256^3 grid then exceeds the
+        # axon shim's remote-compile request limit (HTTP 413)
         def render_plain(cam):
             regime = slicer.choose_axis(cam)
             fn = compiled.get(("p", regime))
             if fn is None:
                 spec = slicer.make_spec(cam, vol.data.shape, cfg, regime)
-                fn = jax.jit(lambda c: slicer.raycast_mxu(
-                    vol, tf, c, w, h, spec).image)
+                fn = jax.jit(lambda v, c: slicer.raycast_mxu(
+                    v, tf, c, w, h, spec).image)
                 compiled[("p", regime)] = fn
-            return fn(cam)
+            return fn(vol, cam)
 
         def render_vdi_step(cam):
             regime = slicer.choose_axis(cam)
             fn = compiled.get(("v", regime))
             if fn is None:
                 spec = slicer.make_spec(cam, vol.data.shape, cfg, regime)
-                fn = jax.jit(lambda c: slicer.generate_vdi_mxu(
-                    vol, tf, c, spec,
+                fn = jax.jit(lambda v, c: slicer.generate_vdi_mxu(
+                    v, tf, c, spec,
                     VDIConfig(max_supersegments=args.k,
                               adaptive_iters=2))[0])
                 compiled[("v", regime)] = fn
-            return fn(cam)
+            return fn(vol, cam)
     else:
         rcfg = RenderConfig(width=w, height=h, max_steps=args.steps)
-        render_plain = jax.jit(
-            lambda c: raycast(vol, tf, c, w, h, rcfg).image)
-        render_vdi_step = jax.jit(
-            lambda c: generate_vdi(vol, tf, c, w, h,
-                                   VDIConfig(max_supersegments=args.k,
-                                             adaptive_iters=2),
-                                   max_steps=args.steps)[0])
+        plain_j = jax.jit(
+            lambda v, c: raycast(v, tf, c, w, h, rcfg).image)
+        vdi_j = jax.jit(
+            lambda v, c: generate_vdi(v, tf, c, w, h,
+                                      VDIConfig(max_supersegments=args.k,
+                                                adaptive_iters=2),
+                                      max_steps=args.steps)[0])
+        render_plain = lambda c: plain_j(vol, c)
+        render_vdi_step = lambda c: vdi_j(vol, c)
 
     if args.mode == "plain":
         render, to_image = render_plain, None

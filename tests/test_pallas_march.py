@@ -12,7 +12,8 @@ import pytest
 from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.transfer import for_dataset
-from scenery_insitu_tpu.core.volume import procedural_volume
+from scenery_insitu_tpu.core.volume import (Volume,
+                                             procedural_volume)
 from scenery_insitu_tpu.ops import pallas_march as pm
 from scenery_insitu_tpu.ops import slicer
 from scenery_insitu_tpu.ops import supersegments as ss
@@ -203,3 +204,45 @@ def test_auto_fold_resolution_and_probe():
     pm._FOLD_PROBE.clear()
     spec_p = slicer.make_spec(cam, (16, 16, 16), PALLAS)
     assert spec_p.fold == "pallas"
+
+
+def test_skip_chunks_execute_through_pallas_fold(tf):
+    """Occupancy skipping EXECUTES the C=1 empty-sample branch through the
+    fused fold (the blob fixture above rarely leaves a whole chunk empty,
+    so the lax.cond skip branch only gets traced there, not run): a
+    corner blob leaves most chunks provably empty, occupancy must skip
+    them, and the pallas fold must still match the xla fold and the
+    skip_empty=False reference exactly."""
+    size = 40
+    z, y, x = np.meshgrid(*(np.linspace(-1, 1, size, dtype=np.float32),)
+                          * 3, indexing="ij")
+    field = np.exp(-(((x - 0.7) ** 2 + (y - 0.7) ** 2 + (z - 0.7) ** 2)
+                     / 0.02)).astype(np.float32)
+    vol = Volume.centered(jnp.asarray(field), extent=2.0)
+
+    cam = Camera.create((0.3, 0.5, 2.8), fov_y_deg=45.0, near=0.3, far=10.0)
+    spec_p = slicer.make_spec(cam, vol.data.shape, PALLAS)
+    occ = np.asarray(slicer.chunk_occupancy(vol, tf, spec_p))
+    assert (~occ).sum() >= 1, "fixture must leave at least one empty chunk"
+
+    cfg = VDIConfig(max_supersegments=6, adaptive_mode="histogram",
+                    histogram_bins=8)
+    vdi_p, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec_p, cfg)
+    spec_x = slicer.make_spec(cam, vol.data.shape, XLA)
+    vdi_x, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec_x, cfg)
+    spec_off = slicer.make_spec(
+        cam, vol.data.shape,
+        SliceMarchConfig(matmul_dtype="f32", scale=1.5, fold="pallas",
+                         skip_empty=False))
+    vdi_off, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec_off, cfg)
+
+    np.testing.assert_allclose(np.asarray(vdi_p.color),
+                               np.asarray(vdi_x.color), rtol=2e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vdi_p.color),
+                               np.asarray(vdi_off.color), rtol=2e-6,
+                               atol=1e-6)
+    dp = np.nan_to_num(np.asarray(vdi_p.depth), posinf=1e9)
+    dx = np.nan_to_num(np.asarray(vdi_x.depth), posinf=1e9)
+    doff = np.nan_to_num(np.asarray(vdi_off.depth), posinf=1e9)
+    np.testing.assert_allclose(dp, dx, rtol=2e-6, atol=1e-5)
+    np.testing.assert_allclose(dp, doff, rtol=2e-6, atol=1e-5)

@@ -220,12 +220,38 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
                                  jnp.arange(nchunks))
             return ss.finalize(st)
     elif variant.startswith("pallas"):
-        tile = int(variant[8:]) if len(variant) > 6 else None
+        # pallas_tN: strip height N; pallas_wN: block width N (the
+        # production kernel picks width by VMEM budget — see
+        # pm._pick_block_w; these variants sweep the geometry on hardware)
+        tile = wblk = None
+        if variant != "pallas":
+            suffix = variant[6:]
+            if suffix.startswith("_t") and suffix[2:].isdigit():
+                tile = int(suffix[2:])
+            elif suffix.startswith("_w") and suffix[2:].isdigit():
+                wblk = int(suffix[2:])
+            else:
+                # fail fast: a typo'd sweep name must not silently record
+                # the default geometry under the sweep label
+                raise ValueError(f"unknown pallas variant {variant!r} "
+                                 "(expected pallas, pallas_tN or pallas_wN)")
 
         def run():
             old = pm.TILE_H
+            old_w = pm._FORCE_BLOCK_W
+            force_w = wblk
             if tile is not None:
                 pm.TILE_H = tile
+                if force_w is None:
+                    # pin the block width to the DEFAULT geometry's choice:
+                    # the budget-driven pick scales with strip height, so
+                    # without this a t-sweep would also narrow the blocks
+                    # and confound the two geometry axes
+                    fpp = (2 * 2 * (6 * c + 1 + 6 * max(k, pm._EST_K)
+                                    + 12 + 1) + 7 * c + 64)
+                    force_w = pm._pick_block_w(w, 4 * 8 * fpp)
+            if force_w is not None:
+                pm._FORCE_BLOCK_W = force_w
             try:
                 def body(packed, ci):
                     rgba, t0, t1 = stream_chunk(ci, c, h, w)
@@ -236,6 +262,7 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
                 return ss.finalize(pm.unpack_state(packed))
             finally:
                 pm.TILE_H = old
+                pm._FORCE_BLOCK_W = old_w
     elif variant == "events":
         def run():
             def body(carry, ci):

@@ -45,9 +45,16 @@ seg_rgba[0:4], seg_start[4], seg_end[5], prev_rgb[6:9], open[9],
 prev_empty[10], k-count[11] (f32-encoded). ``input_output_aliases`` pins
 each state input to its output so XLA updates in place.
 
-Tiling: (8, W) strips — 8 sublanes × the full row width, grid over H/8.
-W needn't be a multiple of 128: a strip is the whole (only) block of its
-row range, so Mosaic masks the lane padding and no HBM copy is spent on
+Tiling: (8, WB) strips — 8 sublanes × a width block, grid over
+(H/8, ceil(W/WB)). WB is the full row when the strip's VMEM estimate fits
+the scoped budget (320-wide frames keep the round-2 single-block schedule)
+and otherwise the largest multiple of 128 that does: at the 512^3 bench
+scale (W=640, K=C=16) the full-width strip demands 16.39 MB scoped VMEM
+against Mosaic's 16 MB limit — over by 2.5% — and the standalone compile
+probe passes while the same kernel embedded in the frame's while/cond
+fails on the extra stack frames, so the geometry must leave headroom
+rather than ride the limit. W needn't be a multiple of the block: the
+last block's lane padding is masked by Mosaic and no HBM copy is spent on
 alignment. H must be a multiple of 8 (`slicer.make_spec` guarantees it).
 On CPU (tests, the virtual mesh) the kernels run in interpret mode.
 """
@@ -72,6 +79,50 @@ _SEG_START, _SEG_END = 4, 5
 _PREV_RGB = slice(6, 9)
 _OPEN, _PREV_EMPTY, _K = 9, 10, 11
 _NSMALL = 12
+
+
+# VMEM budget the strip ESTIMATE must fit in. The estimate is deliberately
+# conservative — ~1.65x the 16.39 MB Mosaic measured for the K=16/C=16
+# 640-wide strip (scoped-vmem error, window 2) — so 14 MB of estimate is
+# ~8.5 MB of true usage: ample headroom under the 16 MB scoped limit for
+# Mosaic's stack frames when the kernel sits inside lax control flow (the
+# 512^3 OOM rode the limit and lost by 404 KB). 14 MB is calibrated so the
+# default-config 320-wide strip (estimate 13.5 MB, true ~8.4 MB) keeps the
+# round-2 single-block schedule the window-2 microbench numbers were
+# captured under, while 640-wide strips tile to wb=256.
+_VMEM_STRIP_BUDGET = 14 * 1024 * 1024
+# geometry override for benchmarks/fold_microbench.py's hardware sweeps;
+# None = budget-driven choice
+_FORCE_BLOCK_W: Optional[int] = None
+# fold_chunk's VMEM estimate treats K as at least this value, so the block
+# width is IDENTICAL for every K <= _EST_K and `fold_compile_ok` (which
+# probes at _EST_K) compiles the exact geometry production will run; with
+# a K-dependent estimate a K=32 probe would pick a NARROWER (cheaper)
+# block than a K=16 production kernel and could pass where production
+# OOMs. K > _EST_K shrinks the block further (VMEM-safe) but then the
+# probe geometry no longer matches — probe explicitly at that K.
+_EST_K = 32
+
+
+def _pick_block_w(w: int, bytes_per_col: int) -> int:
+    """Widest block (full row, else a multiple of 128 lanes) whose strip
+    VMEM estimate stays under the budget. ``bytes_per_col`` is the
+    estimate for one pixel column of the strip (all TILE_H rows)."""
+    if _FORCE_BLOCK_W is not None:
+        return min(w, _FORCE_BLOCK_W)
+    if w * bytes_per_col <= _VMEM_STRIP_BUDGET:
+        return w
+    wb = (_VMEM_STRIP_BUDGET // bytes_per_col) // 128 * 128
+    if wb < 128:
+        import warnings
+
+        warnings.warn(
+            f"pallas_march strip needs {bytes_per_col * 128 / 2**20:.1f} MB "
+            "VMEM at the 128-lane minimum block width — over the "
+            f"{_VMEM_STRIP_BUDGET / 2**20:.0f} MB budget; compiling at the "
+            "floor anyway (Mosaic may reject it; the fold probe / auto "
+            "mode falls back to the XLA fold)", stacklevel=3)
+    return max(128, min(wb, w))
 
 
 # ------------------------------------------------------------- state packing
@@ -236,9 +287,19 @@ def fold_chunk(packed, rgba: jnp.ndarray, t0: jnp.ndarray, t1: jnp.ndarray,
     td = jnp.stack([t0, t1], axis=1)                       # [C, 2, H, W]
     with_count = count is not None
 
-    grid = (h // TILE_H,)
-    row = lambda *lead: pl.BlockSpec(lead + (TILE_H, w),
-                                     lambda j: (0,) * len(lead) + (j, 0))
+    # strip VMEM estimate per pixel column: in+out blocks double-buffered
+    # (×2×2), plus the phase-2 event arrays (7 floats per slice) and slack
+    # for phase-1 SSA temporaries; K floored at _EST_K so the chosen block
+    # width matches the compile probe's geometry (see _EST_K)
+    k_est = max(kk, _EST_K)
+    # the count plane is budgeted whether or not it rides along, for the
+    # same probe-geometry-invariance reason as k_est
+    floats_per_px = (2 * 2 * (6 * c + 1 + 6 * k_est + _NSMALL + 1)
+                     + 7 * c + 64)
+    wb = _pick_block_w(w, 4 * TILE_H * floats_per_px)
+    grid = (h // TILE_H, pl.cdiv(w, wb))
+    row = lambda *lead: pl.BlockSpec(lead + (TILE_H, wb),
+                                     lambda j, i: (0,) * len(lead) + (j, i))
     state_specs = [row(kk, 4), row(kk, 2), row(_NSMALL)]
     state_shapes = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in packed]
     in_specs = [row(c, 4), row(c, 2), row()] + list(state_specs)
@@ -308,12 +369,14 @@ def count_multi_chunk(carry, rgba: jnp.ndarray, tvec, *,
         raise ValueError(f"height {h} not a multiple of {TILE_H}")
     tvec3 = jnp.asarray(tvec, jnp.float32).reshape(b, 1, 1)
 
-    row = lambda *lead: pl.BlockSpec(lead + (TILE_H, w),
-                                     lambda j: (0,) * len(lead) + (j, 0))
+    floats_per_px = 2 * 2 * (4 * c + 2 * (b + 4)) + 32
+    wb = _pick_block_w(w, 4 * TILE_H * floats_per_px)
+    row = lambda *lead: pl.BlockSpec(lead + (TILE_H, wb),
+                                     lambda j, i: (0,) * len(lead) + (j, i))
     out = pl.pallas_call(
-        _count_kernel, grid=(h // TILE_H,),
+        _count_kernel, grid=(h // TILE_H, pl.cdiv(w, wb)),
         in_specs=[row(c, 4),
-                  pl.BlockSpec((b, 1, 1), lambda j: (0, 0, 0)),
+                  pl.BlockSpec((b, 1, 1), lambda j, i: (0, 0, 0)),
                   row(b), row(3), row()],
         out_specs=[row(b), row(3), row()],
         out_shape=[jax.ShapeDtypeStruct((b, h, w), jnp.int32),
@@ -340,15 +403,19 @@ def fold_compile_ok(max_k: int = 32, chunk: int = 16,
                     width: int = 2048) -> bool:
     """One-time probe: does Mosaic accept the fold kernel AT THIS SHAPE on
     the current backend? Like sim/pallas_stencil._compile_ok, this
-    catches a compile rejection (typically VMEM exhaustion — shape
-    dependent, so the probe must use the real K/chunk/width, not a toy
-    shape) HERE, where `slicer.make_spec`'s "auto" resolution can fall
-    back to the XLA fold — instead of inside a traced frame step (e.g.
-    the driver's entry() compile check) where nothing can. The kernel's
-    VMEM use per strip scales with (max_k, chunk, width) and is
-    height-independent (one TILE_H strip per grid step); defaults are
-    conservative upper bounds for this framework's configs. Cached per
-    (backend, shape); failures are warned, not silent."""
+    catches a compile rejection (typically a Mosaic resource limit —
+    shape dependent, so the probe must use the real K/chunk/width, not a
+    toy shape) HERE, where `slicer.make_spec`'s "auto" resolution can
+    fall back to the XLA fold — instead of inside a traced frame step
+    (e.g. the driver's entry() compile check) where nothing can. Strip
+    VMEM scales with (max_k, chunk) and — since `_pick_block_w` caps the
+    block width by the budget — is insensitive to width beyond the cap;
+    probing at the real width still matters because it fixes the BLOCK
+    width (and thus the exact kernel Mosaic sees), not because wider
+    frames cost more VMEM. Height never matters (one TILE_H strip per
+    grid step). Defaults are conservative upper bounds for this
+    framework's configs. Cached per (backend, shape); failures are
+    warned, not silent."""
     key = (jax.default_backend(), int(max_k), int(chunk), int(width))
     ok = _FOLD_PROBE.get(key)
     if ok is None:

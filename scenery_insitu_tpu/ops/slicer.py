@@ -138,9 +138,11 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     fold = cfg.fold
     if fold == "auto":
         # interpret-mode pallas is far slower than the XLA scan on CPU;
-        # on TPU a one-time Mosaic compile probe AT THIS SPEC'S strip
-        # width (K probed at a conservative 32 — VDIConfig's K is not
-        # known here) gates the kernel so a hardware/compiler rejection
+        # on TPU a one-time Mosaic compile probe AT THIS SPEC'S frame
+        # width — which fixes the budget-capped BLOCK width and thus the
+        # exact kernel Mosaic sees (K probed at a conservative 32 —
+        # VDIConfig's K is not known here) — gates the kernel so a
+        # hardware/compiler rejection
         # degrades to the XLA fold instead of failing inside a traced
         # frame step (same pattern as the fused sim stencil's probe)
         fold = ("pallas" if jax.default_backend() == "tpu"

@@ -246,3 +246,45 @@ def test_skip_chunks_execute_through_pallas_fold(tf):
     doff = np.nan_to_num(np.asarray(vdi_off.depth), posinf=1e9)
     np.testing.assert_allclose(dp, dx, rtol=2e-6, atol=1e-5)
     np.testing.assert_allclose(dp, doff, rtol=2e-6, atol=1e-5)
+
+
+def test_fold_chunk_width_tiled_matches_sequential_push():
+    """Multi-block width tiling (wb < w: 2D grid, masked partial last
+    block) must match the sequential push exactly — the production
+    trigger is frame widths whose strip VMEM estimate exceeds the
+    budget (512^3 -> 640-wide strips OOM'd Mosaic's 16 MB scoped limit
+    on hardware), which no test-sized frame reaches, so force the
+    geometry through _FORCE_BLOCK_W: 320 = 128 + 128 + 64-masked."""
+    h, w = 16, 320
+    k, c = 6, 5
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(11), c, h, w)
+    thr = jnp.full((h, w), 0.25, jnp.float32)
+
+    st, cst = _fold_xla(rgba, t0, t1, thr, k)
+    old = pm._FORCE_BLOCK_W
+    pm._FORCE_BLOCK_W = 128
+    try:
+        packed, cnt = pm.fold_chunk(
+            pm.init_packed(k, h, w), rgba, t0, t1, thr, max_k=k,
+            count=jnp.zeros((h, w), jnp.int32), interpret=True)
+        carry = pm.init_count_multi_packed(3, h, w)
+        tvec = jnp.asarray([0.1, 0.25, 0.6])
+        carry = pm.count_multi_chunk(carry, rgba, tvec, interpret=True)
+    finally:
+        pm._FORCE_BLOCK_W = old
+    got = pm.unpack_state(packed)
+    np.testing.assert_allclose(np.asarray(st.out_color),
+                               np.asarray(got.out_color), rtol=1e-6,
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        np.nan_to_num(np.asarray(st.out_start), posinf=1e9),
+        np.nan_to_num(np.asarray(got.out_start), posinf=1e9),
+        rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(st.k), np.asarray(got.k))
+    np.testing.assert_array_equal(np.asarray(cst.count), np.asarray(cnt))
+
+    cm = ss.init_count_multi(3, h, w)
+    for i in range(c):
+        cm = ss.push_count(cm, tvec[:, None, None], rgba[i])
+    np.testing.assert_array_equal(np.asarray(carry[0]),
+                                  np.asarray(cm.count))

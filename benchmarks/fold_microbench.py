@@ -224,21 +224,27 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
         # production kernel picks width by VMEM budget — see
         # pm._pick_block_w; these variants sweep the geometry on hardware)
         tile = wblk = None
+        gated = False
         if variant != "pallas":
             suffix = variant[6:]
             if suffix.startswith("_t") and suffix[2:].isdigit():
                 tile = int(suffix[2:])
             elif suffix.startswith("_w") and suffix[2:].isdigit():
                 wblk = int(suffix[2:])
+            elif suffix == "_gated":
+                gated = True
             else:
                 # fail fast: a typo'd sweep name must not silently record
                 # the default geometry under the sweep label
                 raise ValueError(f"unknown pallas variant {variant!r} "
-                                 "(expected pallas, pallas_tN or pallas_wN)")
+                                 "(expected pallas, pallas_gated, "
+                                 "pallas_tN or pallas_wN)")
 
         def run():
             old = pm.TILE_H
             old_w = pm._FORCE_BLOCK_W
+            old_g = pm._PHASE2_GATED
+            pm._PHASE2_GATED = gated
             force_w = wblk
             if tile is not None:
                 pm.TILE_H = tile
@@ -263,6 +269,7 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
             finally:
                 pm.TILE_H = old
                 pm._FORCE_BLOCK_W = old_w
+                pm._PHASE2_GATED = old_g
     elif variant == "events":
         def run():
             def body(carry, ci):
@@ -325,14 +332,21 @@ def main():
     if args.check:
         import numpy as np
         ref = jax.jit(build("xla", s_total, args.chunk, args.k, h, w))()
-        for v in ("pallas", "events"):
+        # every requested fold-producing variant (anything but the xla
+        # reference and the non-folding floors) must match the xla fold —
+        # a geometry/schedule variant with wrong numerics must not get
+        # its timing recorded as a valid datapoint
+        check_variants = [v.strip() for v in args.variants.split(",")
+                          if v.strip() not in ("xla", "count", "none")]
+        for v in check_variants or ("pallas", "events"):
             got = jax.jit(build(v, s_total, args.chunk, args.k, h, w))()
             for a, b, name in [(ref[0], got[0], "color"),
                                (ref[1], got[1], "depth")]:
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=1e-5, atol=1e-5,
                                            err_msg=f"{v} {name}")
-        print("[fold_microbench] parity check passed (pallas, events)",
+        print("[fold_microbench] parity check passed "
+              f"({', '.join(check_variants or ('pallas', 'events'))})",
               file=sys.stderr, flush=True)
 
     for variant in args.variants.split(","):

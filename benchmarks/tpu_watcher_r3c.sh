@@ -61,8 +61,8 @@ run_step() {  # run_step <n>
          SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=900 \
          python bench.py ;;
     2) run_jsonl "$R/fold_microbench_512_tpu_r3.jsonl" 2400 \
-         python benchmarks/fold_microbench.py --grid 512 --iters 3 \
-         --variants count,xla,pallas,pallas_w128,pallas_t16 ;;
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --variants count,xla,pallas,pallas_gated,pallas_w128,pallas_t16 ;;
     3) run_json "$R/novel_view_tpu_r3.json" 1500 \
          python benchmarks/novel_view_bench.py --iters 3 ;;
     4) run_json "$R/composite_tpu_r3.json" 1200 env SITPU_BENCH_REAL=1 \
@@ -79,6 +79,9 @@ run_step() {  # run_step <n>
     9) run_json "$R/bench_tpu_r3_512_xlafold.json" 1500 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=xla \
          SITPU_BENCH_CHILD_TIMEOUT=900 python bench.py ;;
+    10) run_jsonl "$R/fold_microbench_512_c32_tpu_r3.jsonl" 1800 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --chunk 32 --variants xla,pallas,pallas_gated ;;
   esac
 }
 
@@ -93,6 +96,7 @@ step_out() {  # marker file for step <n>
     7) echo "$R/scaling_tpu_r3.json" ;;
     8) echo "$R/bench_tpu_r3_256_tiledfold.json" ;;
     9) echo "$R/bench_tpu_r3_512_xlafold.json" ;;
+    10) echo "$R/fold_microbench_512_c32_tpu_r3.jsonl" ;;
   esac
 }
 
@@ -100,7 +104,7 @@ step_out() {  # marker file for step <n>
 # marker) so a deterministic failure can't starve the steps behind it; a
 # later tunnel recovery doesn't resurrect it — rerun by deleting
 # /tmp/r3c_fail.<n>
-NSTEPS=9
+NSTEPS=10
 MAXFAIL=2
 for i in $(seq 1 300); do
   next=""

@@ -102,6 +102,14 @@ _FORCE_BLOCK_W: Optional[int] = None
 # OOMs. K > _EST_K shrinks the block further (VMEM-safe) but then the
 # probe geometry no longer matches — probe explicitly at that K.
 _EST_K = 32
+# phase-2 schedule experiment (benchmarks/fold_microbench.py variant
+# "pallas_gated"): skip the event-extraction math for slot rows with no
+# close event anywhere in the block — a chunk typically closes only a few
+# consecutive slots per pixel, so most of the K x C extraction work sums
+# zeros. Off by default until hardware shows it wins (the gate adds a
+# scalar reduction + branch per slot row, and Mosaic's lowering cost for
+# that is unknown).
+_PHASE2_GATED = False
 
 
 def _pick_block_w(w: int, bytes_per_col: int) -> int:
@@ -240,7 +248,7 @@ def _fold_kernel(*refs, max_k: int, gap_eps: float, with_count: bool):
     ev_s = jnp.stack([e[2] for e in events])               # [C, TH, W]
     ev_e = jnp.stack([e[3] for e in events])               # [C, TH, W]
 
-    def slot_body(kk, _):
+    def _extract(kk):
         m = ev_slot == kk.astype(jnp.float32)
         mf = m.astype(jnp.float32)
         hit = jnp.any(m, axis=0)
@@ -256,7 +264,24 @@ def _fold_kernel(*refs, max_k: int, gap_eps: float, with_count: bool):
         do_[pl.dslice(kk, 1)] = jnp.stack(
             [jnp.where(hit, acc_s, drow[0, 0]),
              jnp.where(hit, acc_e, drow[0, 1])])[None]
-        return 0
+
+    def _copy_row(kk):
+        co[pl.dslice(kk, 1)] = ci_[pl.dslice(kk, 1)]
+        do_[pl.dslice(kk, 1)] = di_[pl.dslice(kk, 1)]
+
+    if _PHASE2_GATED:
+        # a row with no event anywhere in the block only needs the
+        # passthrough copy (the out block must still be fully written —
+        # it is a fresh VMEM buffer, not the input)
+        def slot_body(kk, _):
+            kf = kk.astype(jnp.float32)
+            row_has_event = jnp.any(ev_slot == kf)
+            jax.lax.cond(row_has_event, _extract, _copy_row, kk)
+            return 0
+    else:
+        def slot_body(kk, _):
+            _extract(kk)
+            return 0
 
     jax.lax.fori_loop(0, max_k, slot_body, 0)
 

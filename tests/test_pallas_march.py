@@ -288,3 +288,32 @@ def test_fold_chunk_width_tiled_matches_sequential_push():
         cm = ss.push_count(cm, tvec[:, None, None], rgba[i])
     np.testing.assert_array_equal(np.asarray(carry[0]),
                                   np.asarray(cm.count))
+
+
+def test_fold_chunk_gated_phase2_matches_sequential_push():
+    """_PHASE2_GATED skips the event extraction for slot rows with no
+    close event anywhere in the block; the passthrough copy must leave
+    those rows bit-identical and the gated rows must still extract
+    exactly (same stream as the ungated parity test)."""
+    h, w = 16, 40
+    k, c = 6, 5
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(3), c, h, w)
+    thr = jnp.full((h, w), 0.25, jnp.float32)
+    st, _ = _fold_xla(rgba, t0, t1, thr, k)
+
+    old = pm._PHASE2_GATED
+    pm._PHASE2_GATED = True
+    try:
+        packed = pm.fold_chunk(pm.init_packed(k, h, w), rgba, t0, t1,
+                               thr, max_k=k, interpret=True)
+    finally:
+        pm._PHASE2_GATED = old
+    got = pm.unpack_state(packed)
+    np.testing.assert_allclose(np.asarray(st.out_color),
+                               np.asarray(got.out_color), rtol=1e-6,
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        np.nan_to_num(np.asarray(st.out_start), posinf=1e9),
+        np.nan_to_num(np.asarray(got.out_start), posinf=1e9),
+        rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(st.k), np.asarray(got.k))

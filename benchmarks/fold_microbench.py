@@ -249,13 +249,19 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
             if tile is not None:
                 pm.TILE_H = tile
                 if force_w is None:
-                    # pin the block width to the DEFAULT geometry's choice:
-                    # the budget-driven pick scales with strip height, so
+                    # pin the block width to the DEFAULT geometry's choice
+                    # (the budget-driven pick scales with strip height, so
                     # without this a t-sweep would also narrow the blocks
-                    # and confound the two geometry axes
+                    # and confound the two geometry axes) — clamped to
+                    # what the budget allows AT the forced height, else a
+                    # taller strip at the default width would blow the
+                    # scoped-VMEM limit outright; when the clamp engages,
+                    # compare against the matching pallas_wN row for the
+                    # controlled same-width height comparison
                     fpp = (2 * 2 * (6 * c + 1 + 6 * max(k, pm._EST_K)
                                     + 12 + 1) + 7 * c + 64)
-                    force_w = pm._pick_block_w(w, 4 * 8 * fpp)
+                    force_w = min(pm._pick_block_w(w, 4 * 8 * fpp),
+                                  pm._pick_block_w(w, 4 * tile * fpp))
             if force_w is not None:
                 pm._FORCE_BLOCK_W = force_w
             try:

@@ -82,6 +82,10 @@ run_step() {  # run_step <n>
     10) run_jsonl "$R/fold_microbench_512_c32_tpu_r3.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 32 --variants xla,pallas,pallas_gated ;;
+    11) run_json "$R/bench_tpu_r3_1024.json" 2100 env \
+         SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
+         python bench.py ;;
   esac
 }
 
@@ -97,6 +101,7 @@ step_out() {  # marker file for step <n>
     8) echo "$R/bench_tpu_r3_256_tiledfold.json" ;;
     9) echo "$R/bench_tpu_r3_512_xlafold.json" ;;
     10) echo "$R/fold_microbench_512_c32_tpu_r3.jsonl" ;;
+    11) echo "$R/bench_tpu_r3_1024.json" ;;
   esac
 }
 
@@ -104,7 +109,7 @@ step_out() {  # marker file for step <n>
 # marker) so a deterministic failure can't starve the steps behind it; a
 # later tunnel recovery doesn't resurrect it — rerun by deleting
 # /tmp/r3c_fail.<n>
-NSTEPS=10
+NSTEPS=11
 MAXFAIL=2
 for i in $(seq 1 300); do
   next=""

@@ -17,6 +17,8 @@ for each schedule:
   pallas_t16/32  same kernel, taller strips (monkeypatched TILE_H)
   events       local phase-2-UNROLLED twin of the production kernel
                (rolled-vs-unrolled phase-2 A/B; see _events_kernel)
+  scratch      twin writing close events to an explicit VMEM scratch
+               array instead of SSA live ranges (see _scratch_kernel)
   count        pm.count_multi_chunk with 1 candidate — the O(1)-state
                floor: stream generation + predicate, no K-slot writes
   none         stream generation only (the harness overhead floor)
@@ -186,6 +188,115 @@ def events_fold_chunk(big, small, rgba, t0, t1, threshold, *, max_k: int,
     return (out[0], out[1]), out[2]
 
 
+def _scratch_kernel(rgba_ref, td_ref, thr_ref,
+                    ci_, di_, smi_, co, do_, smo,
+                    ev_ref, *, max_k: int):
+    """Scratch-buffer twin of the production two-phase fold: identical
+    phases, but the per-slice close events are WRITTEN to an explicit
+    VMEM scratch array (`ev_ref` f32[C, 7, TH, W]: slot, rgba[4], t0,
+    t1) as they are produced, instead of carried as SSA values until
+    phase 2. Hypothesis under test ('--variants scratch'): the
+    production kernel's 7xC deferred event values live across the whole
+    unrolled slice loop, and Mosaic's spill schedule for those live
+    ranges — not the state machine or the K-state traffic — is where
+    the fold's 300x-above-floor cost hides. If this kernel beats the
+    production one on hardware, the scratch layout gets promoted."""
+    nc = rgba_ref.shape[0]
+    thr = thr_ref[...]
+    sm = smi_[...]
+    seg_rgba = sm[0:4]
+    seg_start, seg_end = sm[4], sm[5]
+    prev_rgb = sm[6:9]
+    open_ = sm[9] > 0.5
+    prev_empty = sm[10] > 0.5
+    kcnt = sm[11]
+
+    for i in range(nc):
+        rgba = rgba_ref[i]
+        t0 = td_ref[i, 0]
+        t1 = td_ref[i, 1]
+        is_empty = rgba[3] < ss.EMPTY_ALPHA
+        d = rgba[:3] - prev_rgb
+        diff = jnp.sqrt(jnp.sum(d * d, axis=0))
+        want_break = ((~is_empty & ~prev_empty & (diff > thr))
+                      | (is_empty & ~prev_empty))
+        do_close = open_ & want_break & (kcnt < max_k - 1)
+        ev_ref[i] = jnp.concatenate([
+            jnp.where(do_close, kcnt, -1.0)[None],
+            jnp.where(do_close[None], seg_rgba, 0.0),
+            jnp.where(do_close, seg_start, 0.0)[None],
+            jnp.where(do_close, seg_end, 0.0)[None]])
+        kcnt = jnp.where(do_close, kcnt + 1.0, kcnt)
+        open_ = open_ & ~do_close
+        start_new = ~is_empty & ~open_
+        accumulate = ~is_empty & open_
+        seg_rgba = jnp.where(start_new[None], rgba,
+                             jnp.where(accumulate[None],
+                                       seg_rgba + (1.0 - seg_rgba[3:4])
+                                       * rgba, seg_rgba))
+        seg_start = jnp.where(start_new, t0, seg_start)
+        seg_end = jnp.where(start_new | accumulate, t1, seg_end)
+        open_ = open_ | start_new
+        prev_rgb = jnp.where(is_empty[None], prev_rgb, rgba[:3])
+        prev_empty = is_empty
+
+    smo[...] = jnp.concatenate([
+        seg_rgba, seg_start[None], seg_end[None], prev_rgb,
+        open_.astype(jnp.float32)[None],
+        prev_empty.astype(jnp.float32)[None], kcnt[None]])
+
+    import jax as _jax
+    from jax.experimental import pallas as _pl
+
+    def slot_body(kk, _):
+        ev = ev_ref[...]                       # [C, 7, TH, W]
+        m = ev[:, 0] == kk.astype(jnp.float32)
+        mf = m.astype(jnp.float32)
+        hit = jnp.any(m, axis=0)
+        acc_c = jnp.sum(ev[:, 1:5] * mf[:, None], axis=0)
+        acc_s = jnp.sum(ev[:, 5] * mf, axis=0)
+        acc_e = jnp.sum(ev[:, 6] * mf, axis=0)
+        co[_pl.dslice(kk, 1)] = (ci_[_pl.dslice(kk, 1)] + acc_c[None])
+        drow = di_[_pl.dslice(kk, 1)]
+        do_[_pl.dslice(kk, 1)] = jnp.stack(
+            [jnp.where(hit, acc_s, drow[0, 0]),
+             jnp.where(hit, acc_e, drow[0, 1])])[None]
+        return 0
+
+    _jax.lax.fori_loop(0, max_k, slot_body, 0)
+
+
+def scratch_fold_chunk(big, small, rgba, t0, t1, threshold, *,
+                       max_k: int, tile_h: int = 8):
+    """Driver for `_scratch_kernel` (same state layout as events_*)."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    color, depth = big
+    _, _, h, w = color.shape
+    c = rgba.shape[0]
+    threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
+    td = jnp.stack([t0, t1], axis=1)
+    row = lambda *lead: pl.BlockSpec(lead + (tile_h, w),
+                                     lambda j: (0,) * len(lead) + (j, 0))
+    kk = color.shape[0]
+    out = pl.pallas_call(
+        functools.partial(_scratch_kernel, max_k=max_k),
+        grid=(h // tile_h,),
+        in_specs=[row(c, 4), row(c, 2), row(),
+                  row(kk, 4), row(kk, 2), row(12)],
+        out_specs=[row(kk, 4), row(kk, 2), row(12)],
+        out_shape=[jax.ShapeDtypeStruct(color.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(depth.shape, jnp.float32),
+                   jax.ShapeDtypeStruct((12, h, w), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((c, 7, tile_h, w), jnp.float32)],
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=pm.should_interpret(),
+    )(rgba, td, threshold, color, depth, small)
+    return (out[0], out[1]), out[2]
+
+
 def events_init(k: int, h: int, w: int):
     color = jnp.zeros((k, 4, h, w), jnp.float32)
     depth = jnp.full((k, 2, h, w), jnp.inf, jnp.float32)
@@ -283,6 +394,16 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
                 rgba, t0, t1 = stream_chunk(ci, c, h, w)
                 return events_fold_chunk(big, small, rgba, t0, t1, thr,
                                          max_k=k), None
+            carry, _ = jax.lax.scan(body, events_init(k, h, w),
+                                    jnp.arange(nchunks))
+            return events_finalize(*carry)
+    elif variant == "scratch":
+        def run():
+            def body(carry, ci):
+                big, small = carry
+                rgba, t0, t1 = stream_chunk(ci, c, h, w)
+                return scratch_fold_chunk(big, small, rgba, t0, t1, thr,
+                                          max_k=k), None
             carry, _ = jax.lax.scan(body, events_init(k, h, w),
                                     jnp.arange(nchunks))
             return events_finalize(*carry)

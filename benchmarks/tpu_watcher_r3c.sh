@@ -62,7 +62,7 @@ run_step() {  # run_step <n>
          python bench.py ;;
     2) run_jsonl "$R/fold_microbench_512_tpu_r3.jsonl" 2400 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
-         --variants count,xla,pallas,pallas_gated,pallas_w128,pallas_t16 ;;
+         --variants count,xla,pallas,pallas_gated,pallas_w128,pallas_t16,scratch ;;
     3) run_json "$R/novel_view_tpu_r3.json" 1500 \
          python benchmarks/novel_view_bench.py --iters 3 ;;
     4) run_json "$R/composite_tpu_r3.json" 1200 env SITPU_BENCH_REAL=1 \

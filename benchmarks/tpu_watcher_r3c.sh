@@ -57,9 +57,14 @@ run_jsonl() {
 
 run_step() {  # run_step <n>
   case "$1" in
-    1) run_json "$R/bench_tpu_r3_512_tiledfold.json" 2100 env \
-         SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=900 \
+    1) run_json "$R/bench_tpu_r3_512_tiledfold.json" 1000 env \
+         SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
          python bench.py ;;
+       # window-1 evidence: a real 512^3 child finishes in <90 s (compile
+       # 17 s + 25 frames x 0.5 s + transfers), so 420 s/child is ample
+       # while capping the cost of a mid-step tunnel wedge at ~15 min —
+       # short windows (window 2 was ~3 min) must not be burned waiting
+       # on dead children
     2) run_jsonl "$R/fold_microbench_512_tpu_r3.jsonl" 2400 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants count,xla,pallas,pallas_gated,pallas_w128,pallas_t16,scratch ;;

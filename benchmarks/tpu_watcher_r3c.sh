@@ -91,6 +91,8 @@ run_step() {  # run_step <n>
          SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
          python bench.py ;;
+    12) run_jsonl "$R/profile_march_512_tpu_r3.txt" 1800 \
+         python -u benchmarks/profile_march.py 512 ;;
   esac
 }
 
@@ -107,6 +109,7 @@ step_out() {  # marker file for step <n>
     9) echo "$R/bench_tpu_r3_512_xlafold.json" ;;
     10) echo "$R/fold_microbench_512_c32_tpu_r3.jsonl" ;;
     11) echo "$R/bench_tpu_r3_1024.json" ;;
+    12) echo "$R/profile_march_512_tpu_r3.txt" ;;
   esac
 }
 
@@ -114,7 +117,7 @@ step_out() {  # marker file for step <n>
 # marker) so a deterministic failure can't starve the steps behind it; a
 # later tunnel recovery doesn't resurrect it — rerun by deleting
 # /tmp/r3c_fail.<n>
-NSTEPS=11
+NSTEPS=12
 MAXFAIL=2
 for i in $(seq 1 300); do
   next=""

@@ -1,0 +1,70 @@
+"""Print a one-table summary of every committed measurement artifact in
+benchmarks/results/ (bench JSON lines, microbench/config JSONL sweeps).
+Usage: python benchmarks/summarize_results.py
+No JAX import — safe to run anywhere, any time."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+R = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+
+def rows_of(path: str):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or not line.startswith("{"):
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def fmt(r: dict) -> str:
+    if "variant" in r:                           # fold microbench row
+        if "error" in r:
+            return f"variant={r['variant']:14s} ERROR {r['error'][:50]}"
+        return (f"variant={r['variant']:14s} {r['ms_per_march']:8.2f} ms/march"
+                f"  hw={r['hw'][0]}x{r['hw'][1]} k={r['k']} c={r['chunk']}")
+    if "workload" in r:                          # configs sweep row
+        w = r["workload"]
+        return (f"{r.get('metric', '?')}: {r['ms_per_frame']:.0f} ms/frame "
+                f"{w} mode={r.get('mode')} n={r.get('n_devices')}")
+    if "metric" in r:
+        val = r.get("value")
+        unit = r.get("unit", "")
+        cfg = r.get("config", {})
+        plat = cfg.get("platform", r.get("platform", "?"))
+        extra = ""
+        if "ms_per_frame" in r:
+            extra = f"  {r['ms_per_frame']:.1f} ms/frame"
+        elif "ms_per_frame" in cfg:
+            extra = f"  {cfg['ms_per_frame']:.1f} ms/frame"
+        if r.get("error"):
+            return f"{r['metric']}: ERROR {str(r['error'])[:60]}"
+        vs = r.get("vs_baseline")
+        vs_s = f"  vs_baseline={vs}" if vs is not None else ""
+        return (f"{r['metric']}: {val} {unit} [{plat}]"
+                f"{extra}{vs_s}")
+    return json.dumps(r)[:100]
+
+
+def main():
+    for path in sorted(glob.glob(os.path.join(R, "*.json*"))):
+        name = os.path.basename(path)
+        rows = rows_of(path)
+        if not rows:
+            continue
+        print(f"\n== {name}")
+        for r in rows:
+            print("   " + fmt(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,11 +26,14 @@ BASELINE primary scale 512^3 x 25 frames; the CPU fallback drops to
   SITPU_BENCH_GRID=512|128  SITPU_BENCH_WIDTH=1280 SITPU_BENCH_HEIGHT=720
   SITPU_BENCH_STEPS=256 SITPU_BENCH_K=16 SITPU_BENCH_FRAMES=25|5
   SITPU_BENCH_SIM_STEPS=10 SITPU_BENCH_ADAPTIVE_ITERS=2
-  SITPU_BENCH_ENGINE=mxu|gather  SITPU_BENCH_FOLD=auto|pallas|xla
+  SITPU_BENCH_ENGINE=mxu|gather
+  SITPU_BENCH_FOLD=auto|pallas_seg|seg|pallas|xla  (auto = pallas_seg on
+    TPU, probe-gated; see config.SliceMarchConfig.fold for the schedules)
   SITPU_BENCH_PLATFORMS=tpu,tpu,cpu  SITPU_BENCH_CHILD_TIMEOUT=900
-The second consecutive tpu attempt falls back to SITPU_BENCH_FOLD=xla —
-but only if a TPU child actually ran and died, so a probe-level tunnel
-flap never demotes the flagship Pallas schedule.
+The second consecutive tpu attempt falls back to SITPU_BENCH_FOLD=seg
+(the same segmented-scan fold without Mosaic exposure) — but only if a
+TPU child actually ran and died, so a probe-level tunnel flap never
+demotes the flagship Pallas schedule.
 Baseline: the north star of 30 FPS at the 512^3 primary scale.
 vs_baseline is CONFIG-MATCHED: fps/30 at grid=512 (mxu), null otherwise
 (render work scales ~grid^4, sim ~grid^3 — no single exponent converts a
@@ -308,9 +311,11 @@ def _orchestrate():
                 and "SITPU_BENCH_FOLD" not in os.environ):
             # a TPU child actually RAN and died (not a probe failure —
             # a tunnel flap must not demote the flagship Pallas schedule):
-            # retry with the proven XLA fold in case the Pallas march
-            # kernel is what killed it
-            extra["SITPU_BENCH_FOLD"] = "xla"
+            # retry with the pure-XLA segmented-scan fold in case the
+            # Pallas seg kernel is what killed it (same algorithm, no
+            # Mosaic exposure — and still chunk-granular state traffic,
+            # unlike the per-slice "xla" machine fold)
+            extra["SITPU_BENCH_FOLD"] = "seg"
         result, err = _run_child(platform, timeout_s, extra)
         if (platform == "tpu" and err is not None
                 and "probe failed" not in err):

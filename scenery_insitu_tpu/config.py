@@ -103,11 +103,19 @@ class SliceMarchConfig:
     # zero alpha (≅ the reference's OctreeCells occupancy acceleration,
     # VDIGenerator.comp:232-254 — here consumed, per-frame, by the march).
     skip_empty: bool = True
-    # Supersegment-fold schedule for the VDI marches: "xla" = lax.scan with
-    # full-frame SegState (every push round-trips HBM); "pallas" = fused
-    # VMEM pixel-strip kernel (ops/pallas_march.py — state enters/leaves
-    # HBM once per CHUNK, ≅ the reference's single-kernel generation,
-    # VDIGenerator.comp + AccumulateVDI.comp); "auto" = pallas on TPU.
+    # Supersegment-fold schedule for the VDI marches:
+    #   "xla"        sequential ss.push machine in a lax.scan (every slice
+    #                round-trips the [K] state through HBM — the portable
+    #                reference schedule, fastest on CPU);
+    #   "pallas"     round-3 two-phase machine kernel (ops/pallas_march.py);
+    #   "seg"        round-4 segmented-scan fold (ops/seg_fold.py): start
+    #                flags / segment ids / transmittance all data-parallel,
+    #                [K] state touched once per chunk;
+    #   "pallas_seg" the seg fold's VMEM pixel-strip twin (ops/pallas_seg.py,
+    #                ≅ the reference's single-kernel generation,
+    #                VDIGenerator.comp + AccumulateVDI.comp);
+    #   "auto"       pallas_seg on TPU (compile-probe gated, falling back
+    #                to seg), xla elsewhere.
     fold: str = "auto"
 
 

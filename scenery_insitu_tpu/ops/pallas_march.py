@@ -102,6 +102,9 @@ _FORCE_BLOCK_W: Optional[int] = None
 # OOMs. K > _EST_K shrinks the block further (VMEM-safe) but then the
 # probe geometry no longer matches — probe explicitly at that K.
 _EST_K = 32
+# bins floor for the counting kernel's block-width estimate (see
+# count_multi_chunk / count_compile_ok)
+_EST_B = 32
 # phase-2 schedule experiment (benchmarks/fold_microbench.py variant
 # "pallas_gated"): skip the event-extraction math for slot rows with no
 # close event anywhere in the block — a chunk typically closes only a few
@@ -394,7 +397,10 @@ def count_multi_chunk(carry, rgba: jnp.ndarray, tvec, *,
         raise ValueError(f"height {h} not a multiple of {TILE_H}")
     tvec3 = jnp.asarray(tvec, jnp.float32).reshape(b, 1, 1)
 
-    floats_per_px = 2 * 2 * (4 * c + 2 * (b + 4)) + 32
+    # b floored at _EST_B so the block width (the exact kernel geometry
+    # Mosaic sees) is identical for every bins <= _EST_B and matches
+    # `count_compile_ok`'s probe — same invariance argument as _EST_K
+    floats_per_px = 2 * 2 * (4 * c + 2 * (max(b, _EST_B) + 4)) + 32
     wb = _pick_block_w(w, 4 * TILE_H * floats_per_px)
     row = lambda *lead: pl.BlockSpec(lead + (TILE_H, wb),
                                      lambda j, i: (0,) * len(lead) + (j, i))
@@ -420,6 +426,50 @@ def init_count_multi_packed(bins: int, height: int, width: int):
 
 
 # ------------------------------------------------------------ compile probe
+
+_COUNT_PROBE: dict = {}
+
+
+def count_compile_ok(bins: int = 32, chunk: int = 16,
+                     width: int = 2048) -> bool:
+    """One-time Mosaic-acceptance probe for the COUNTING kernel
+    (`count_multi_chunk`) at the real (bins<= _EST_B, chunk, width)
+    geometry. The round-4 "auto" fold resolution requires this alongside
+    the write-fold probe before selecting a pallas schedule: the
+    histogram/temporal-seed counting march runs this kernel, and a
+    rejection must degrade to the XLA counting scan in `make_spec`, not
+    fail inside a traced frame step. Probed at _EST_B bins, which (via
+    the bins floor in the kernel's block-width estimate) is the exact
+    geometry every bins <= _EST_B compiles to."""
+    key = (jax.default_backend(), int(min(bins, _EST_B)), int(chunk),
+           int(width))
+    ok = _COUNT_PROBE.get(key)
+    if ok is None:
+        try:
+            b, c, h, w = int(min(bins, _EST_B)), int(chunk), TILE_H, \
+                int(width)
+            sds = jax.ShapeDtypeStruct
+
+            def f(carry, rgba, tvec):
+                return count_multi_chunk(carry, rgba, tvec)
+
+            carry = (sds((b, h, w), jnp.int32), sds((3, h, w), jnp.float32),
+                     sds((h, w), jnp.float32))
+            jax.jit(f).lower(carry, sds((c, 4, h, w), jnp.float32),
+                             sds((b,), jnp.float32)).compile()
+            ok = True
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"Pallas counting kernel rejected at bins={bins} "
+                f"chunk={chunk} width={width} ({type(e).__name__}: "
+                f"{str(e)[:200]}) — auto fold falls back to an XLA "
+                "schedule.", stacklevel=2)
+            ok = False
+        _COUNT_PROBE[key] = ok
+    return ok
+
 
 _FOLD_PROBE: dict = {}
 

@@ -1,0 +1,204 @@
+"""Pallas TPU kernel for the segmented-scan write fold (ops/seg_fold.py).
+
+Same algorithm as the XLA schedule — parallel start flags, segment ids by
+running count, segmented transmittance, K masked reductions — with the
+memory movement pinned down: the sample chunk, the K-slot state and the
+per-slice ``(slot, v)`` records all live in VMEM pixel strips, and the
+``[K,...]`` state crosses HBM once per chunk via ``input_output_aliases``.
+
+Contrast with the round-3 two-phase kernel (ops/pallas_march.py), which
+kept the *sequential* ``ss.push`` machine and deferred 7×C close-event
+values across the whole unrolled slice loop as SSA live ranges — the
+hardware-measured suspect for its 300×-above-floor cost. Here phase A
+carries just four small values per pixel between slices (running start
+count, running transmittance, prev rgb, prev empty) and writes each
+slice's ``(slot, premultiplied-scaled rgba)`` record straight to a VMEM
+scratch ref, so no live range spans the loop; phase B re-reads the
+scratch per slot row — VMEM-to-register traffic, not HBM.
+
+Semantics are identical to ``seg_fold.seg_fold_chunk`` (tests pin
+interpret-mode equality) and therefore to C sequential ``ss.push`` calls
+up to fp association (≅ the reference's fused single-kernel generation,
+VDIGenerator.comp:380-529 + AccumulateVDI.comp:69-98).
+
+State layout (3 aliased arrays, same convention as pallas_march):
+``color f32[K,4,H,W]``, ``depth f32[K,2,H,W]`` (start/end; start init
++inf, end init -inf), ``small f32[5,H,W]`` = cnt[0] (f32-encoded),
+prev_rgb[1:4], prev_empty[4]. Helpers convert to/from
+``seg_fold.SegFoldState`` so the march code handles ONE state type.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from scenery_insitu_tpu.ops import seg_fold as sf
+from scenery_insitu_tpu.ops import supersegments as ss
+from scenery_insitu_tpu.ops.pallas_march import _pick_block_w
+from scenery_insitu_tpu.ops.pallas_util import TILE_H, should_interpret
+
+_CNT, _PREV_RGB, _PREV_EMPTY = 0, slice(1, 4), 4
+_NSMALL = 5
+# estimate floor on K so the chosen block width (and thus the exact kernel
+# Mosaic compiles) is identical for every K <= _EST_K and matches the
+# compile probe's geometry — same invariance argument as pallas_march._EST_K
+_EST_K = 32
+
+
+def pack_seg_state(st: sf.SegFoldState):
+    small = jnp.concatenate([
+        st.cnt.astype(jnp.float32)[None],
+        st.prev_rgb,
+        st.prev_empty.astype(jnp.float32)[None]])
+    return (st.out_color,
+            jnp.stack([st.out_start, st.out_end], axis=1),
+            small)
+
+
+def unpack_seg_state(packed) -> sf.SegFoldState:
+    color, depth, small = packed
+    return sf.SegFoldState(
+        out_color=color, out_start=depth[:, 0], out_end=depth[:, 1],
+        cnt=small[_CNT].astype(jnp.int32),
+        prev_rgb=small[_PREV_RGB],
+        prev_empty=small[_PREV_EMPTY] > 0.5)
+
+
+def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
+                co, do_, smo, ev_ref, *, max_k: int):
+    nc = rgba_ref.shape[0]
+    thr = thr_ref[...]
+    sm = smi_[...]
+    run_cnt = sm[_CNT]
+    pr = sm[_PREV_RGB]
+    pe = sm[_PREV_EMPTY] > 0.5
+    kf = jnp.float32(max_k - 1)
+
+    # ---- phase A: per-slice records, 4 small live carries
+    t_run = jnp.ones_like(thr)
+    for s in range(nc):
+        rgba = rgba_ref[s]
+        emp = rgba[3] < ss.EMPTY_ALPHA
+        d = rgba[:3] - pr
+        diff = jnp.sqrt(jnp.sum(d * d, axis=0))
+        start = ~emp & (pe | (diff > thr))
+        run_cnt = run_cnt + start.astype(jnp.float32)
+        sid = run_cnt - 1.0
+        reset = start & (sid <= kf)
+        t_here = jnp.where(reset, 1.0, t_run)
+        t_run = t_here * (1.0 - jnp.where(emp, 0.0, rgba[3]))
+        slotf = jnp.where(emp, -1.0, jnp.minimum(sid, kf))
+        v = rgba * (t_here * (~emp).astype(jnp.float32))[None]
+        ev_ref[s] = jnp.concatenate([slotf[None], v])
+        pr = jnp.where(emp[None], pr, rgba[:3])
+        pe = emp
+
+    smo[...] = jnp.concatenate([
+        run_cnt[None], pr, pe.astype(jnp.float32)[None]])
+
+    # ---- phase B: rolled K loop, state touched once per chunk
+    def slot_body(kk, _):
+        ev = ev_ref[...]                                   # [C, 5, TH, WB]
+        m = ev[:, 0] == kk.astype(jnp.float32)
+        mf = m.astype(jnp.float32)
+        contrib = jnp.sum(ev[:, 1:5] * mf[:, None], axis=0)
+        d0 = jnp.min(jnp.where(m, td_ref[:, 0], jnp.inf), axis=0)
+        d1 = jnp.max(jnp.where(m, td_ref[:, 1], -jnp.inf), axis=0)
+        oc = ci_[pl.dslice(kk, 1)]                         # [1, 4, TH, WB]
+        co[pl.dslice(kk, 1)] = oc + (1.0 - oc[:, 3:4]) * contrib[None]
+        dr = di_[pl.dslice(kk, 1)]
+        do_[pl.dslice(kk, 1)] = jnp.stack(
+            [jnp.minimum(dr[0, 0], d0), jnp.maximum(dr[0, 1], d1)])[None]
+        return 0
+
+    jax.lax.fori_loop(0, max_k, slot_body, 0)
+
+
+def _floats_per_px(c: int, k: int) -> int:
+    """Strip VMEM estimate per pixel column: in+out blocks double-buffered
+    (x2x2) + the [C,5] scratch + slack for phase-A temporaries."""
+    return 2 * 2 * (6 * c + 1 + 6 * max(k, _EST_K) + _NSMALL) + 5 * c + 64
+
+
+def seg_fold_chunk(st: sf.SegFoldState, rgba: jnp.ndarray, t0: jnp.ndarray,
+                   t1: jnp.ndarray, threshold: jnp.ndarray, *, max_k: int,
+                   interpret: Optional[bool] = None) -> sf.SegFoldState:
+    """Drop-in twin of ``seg_fold.seg_fold_chunk`` on VMEM pixel strips."""
+    if interpret is None:
+        interpret = should_interpret()
+    packed = pack_seg_state(st)
+    color, depth, small = packed
+    kk = color.shape[0]
+    _, _, h, w = color.shape
+    c = rgba.shape[0]
+    if h % TILE_H:
+        raise ValueError(f"height {h} not a multiple of {TILE_H}")
+    threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
+    td = jnp.stack([t0, t1], axis=1)                       # [C, 2, H, W]
+
+    wb = _pick_block_w(w, 4 * TILE_H * _floats_per_px(c, kk))
+    grid = (h // TILE_H, pl.cdiv(w, wb))
+    row = lambda *lead: pl.BlockSpec(lead + (TILE_H, wb),
+                                     lambda j, i: (0,) * len(lead) + (j, i))
+    state_specs = [row(kk, 4), row(kk, 2), row(_NSMALL)]
+    out = pl.pallas_call(
+        functools.partial(_seg_kernel, max_k=max_k),
+        grid=grid,
+        in_specs=[row(c, 4), row(c, 2), row()] + state_specs,
+        out_specs=state_specs,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype) for p in packed],
+        scratch_shapes=[pltpu.VMEM((c, 5, TILE_H, wb), jnp.float32)],
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret,
+    )(rgba, td, threshold, *packed)
+    return unpack_seg_state(tuple(out))
+
+
+# ------------------------------------------------------------ compile probe
+
+_PROBE: dict = {}
+
+
+def seg_compile_ok(max_k: int = 32, chunk: int = 16,
+                   width: int = 2048) -> bool:
+    """One-time Mosaic-acceptance probe at the REAL (K, chunk, width) so
+    `slicer.make_spec`'s "auto" can fall back to the XLA seg fold instead
+    of failing inside a traced frame step. Cached per (backend, shape)."""
+    key = (jax.default_backend(), int(max_k), int(chunk), int(width))
+    ok = _PROBE.get(key)
+    if ok is None:
+        try:
+            k, c, h, w = int(max_k), int(chunk), TILE_H, int(width)
+            sds = jax.ShapeDtypeStruct
+
+            def f(st, rgba, t0, t1, thr):
+                return seg_fold_chunk(st, rgba, t0, t1, thr, max_k=k)
+
+            st = sf.SegFoldState(
+                out_color=sds((k, 4, h, w), jnp.float32),
+                out_start=sds((k, h, w), jnp.float32),
+                out_end=sds((k, h, w), jnp.float32),
+                cnt=sds((h, w), jnp.int32),
+                prev_rgb=sds((3, h, w), jnp.float32),
+                prev_empty=sds((h, w), jnp.bool_))
+            jax.jit(f).lower(
+                st, sds((c, 4, h, w), jnp.float32),
+                sds((c, h, w), jnp.float32), sds((c, h, w), jnp.float32),
+                sds((h, w), jnp.float32)).compile()
+            ok = True
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"Pallas seg fold rejected at k={max_k} chunk={chunk} "
+                f"width={width} ({type(e).__name__}: {str(e)[:200]}) — "
+                "falling back to the XLA seg fold.", stacklevel=2)
+            ok = False
+        _PROBE[key] = ok
+    return ok

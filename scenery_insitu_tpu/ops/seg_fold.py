@@ -1,0 +1,183 @@
+"""Segmented-scan supersegment WRITE fold — the round-4 redesign of the
+march's hot loop.
+
+Why this exists: the round-3 hardware captures localized ~390 of the 420 ms
+512^3 frame in the supersegment write march, ~300x above the counting
+march's O(1)-state floor (benchmarks/results/README.md). Both prior
+schedules shared one structural property: a *sequential* per-slice state
+machine (``ss.push``) whose every step either round-trips the full
+``[K,4,H,W]`` output state through HBM (the XLA scan) or defers per-slice
+close events across a long unrolled live range (the two-phase Pallas
+kernel, which hardware showed was no faster). The machine itself is the
+bottleneck shape, not its scheduling.
+
+This module removes the sequential machine. The observation that unlocks
+it: the break metric only ever compares a slice against its **immediate
+predecessor** — when the predecessor is empty the break fires regardless
+of the color diff, and when it is non-empty the machine's ``prev_rgb`` IS
+the predecessor's rgb. So the per-slice segment-START flags are computable
+in parallel from a shift by one slice, and everything else follows from
+parallel primitives:
+
+- segment ids = running count of start flags (a cumulative sum);
+  ``slot = min(id, K-1)`` reproduces the machine's merge-overflow exactly
+  (once the counter passes K-1 the machine never closes again, so every
+  later item lands in the last slot);
+- within-segment alpha-under composition factors as
+  ``sum_s rgba_s * T_s`` where ``T_s`` is the product of ``(1 - alpha)``
+  over earlier items of the same slot — a *segmented* running product that
+  resets at each slot's first item (and only there: merged-overflow starts
+  do not reset, matching the machine's never-closing last slot);
+- the K output slots accumulate via K masked reductions over the chunk,
+  touching the ``[K,...]`` state ONCE per chunk, and composition across
+  chunks is the ordinary under rule ``out += (1 - out_alpha) * contrib``
+  (for a slot continuing across the boundary, ``1 - out_alpha`` *is* its
+  carried transmittance).
+
+The result is bit-for-bit the same set of supersegments as C sequential
+``ss.push`` calls (same predicates, same overflow), differing only in
+floating-point association of the within-segment sums (tests pin allclose
+at 1e-5). The true per-pixel start count — the temporal threshold
+controller's feedback signal (``ss.update_threshold``) — is the fold's own
+``cnt`` field, free.
+
+Reference parity: this is the TPU-native replacement for the fused
+generate+accumulate GPU kernel (VDIGenerator.comp:380-529 +
+AccumulateVDI.comp:69-98); the reference's per-ray sequential loop is a
+good GPU shape and a terrible TPU one, hence the re-derivation.
+
+The same algorithm also has a Pallas twin (ops/pallas_seg.py) that keeps
+the stream strip and K-state in VMEM; this XLA version is the portable
+schedule and the fallback when Mosaic rejects the kernel.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.ops import supersegments as ss
+
+
+class SegFoldState(NamedTuple):
+    """Carried fold state. Unlike ``ss.SegState`` there is no open-segment
+    accumulator: slots are written incrementally, and the carried
+    ``out_color`` alpha of the newest slot encodes its transmittance for
+    cross-chunk continuation. ``out_end`` holds ``-inf`` for untouched
+    slots internally (max-merge identity); `seg_finalize` maps unused
+    slots to the ``(+inf, +inf)`` convention of ``ss.finalize``."""
+
+    out_color: jnp.ndarray   # f32[K, 4, H, W] premultiplied, composited
+    out_start: jnp.ndarray   # f32[K, H, W]  (+inf until first item)
+    out_end: jnp.ndarray     # f32[K, H, W]  (-inf until first item)
+    cnt: jnp.ndarray         # i32[H, W] TRUE segment starts so far (uncapped)
+    prev_rgb: jnp.ndarray    # f32[3, H, W] last item's rgb where non-empty
+    prev_empty: jnp.ndarray  # bool[H, W] last item was empty
+
+
+def init_seg_state(k: int, height: int, width: int) -> SegFoldState:
+    return SegFoldState(
+        out_color=jnp.zeros((k, 4, height, width), jnp.float32),
+        out_start=jnp.full((k, height, width), jnp.inf, jnp.float32),
+        out_end=jnp.full((k, height, width), -jnp.inf, jnp.float32),
+        cnt=jnp.zeros((height, width), jnp.int32),
+        prev_rgb=jnp.zeros((3, height, width), jnp.float32),
+        prev_empty=jnp.ones((height, width), bool),
+    )
+
+
+def chunk_flags(rgba: jnp.ndarray, prev_rgb: jnp.ndarray,
+                prev_empty: jnp.ndarray, threshold: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Parallel (empty, start) flags for a chunk of depth-ordered slices.
+
+    ``rgba f32[C,4,H,W]`` premultiplied; carried prev_rgb/prev_empty seed
+    slice 0. The shift-by-one is exact vs the sequential machine: the
+    machine's prev_rgb (last NON-empty rgb) is only consulted when the
+    immediate predecessor was non-empty — in which case they coincide.
+    """
+    emp = rgba[:, 3] < ss.EMPTY_ALPHA                      # [C, H, W]
+    rgb = rgba[:, :3]
+    pr = jnp.concatenate([prev_rgb[None], rgb[:-1]], axis=0)
+    pe = jnp.concatenate([prev_empty[None], emp[:-1]], axis=0)
+    diff = jnp.linalg.norm(rgb - pr, axis=1)
+    starts = ~emp & (pe | (diff > threshold))
+    return emp, starts
+
+
+def seg_fold_chunk(st: SegFoldState, rgba: jnp.ndarray, t0: jnp.ndarray,
+                   t1: jnp.ndarray, threshold: jnp.ndarray, *,
+                   max_k: int) -> SegFoldState:
+    """Fold one chunk of slices. Semantically = C sequential ``ss.push``
+    calls (up to fp association). rgba f32[C,4,H,W]; t0/t1 f32[C,H,W];
+    threshold [H,W] or scalar."""
+    c, _, h, w = rgba.shape
+    emp, starts = chunk_flags(rgba, st.prev_rgb, st.prev_empty, threshold)
+
+    # uncapped segment id per slice; non-empty slices always have id >= 0
+    # (a non-empty slice either starts a segment or continues one, and a
+    # continued segment implies cnt >= 1 on entry)
+    sid = st.cnt[None] + jnp.cumsum(starts.astype(jnp.int32), axis=0) - 1
+    slot = jnp.clip(sid, 0, max_k - 1)
+    # transmittance resets only at each slot's FIRST item: merged-overflow
+    # starts (sid > K-1) keep composing into the last slot
+    reset = starts & (sid <= max_k - 1)
+
+    # no clipping: the factorization sum_s rgba_s * prod(1 - alpha) is the
+    # exact algebraic expansion of the under recurrence for ANY alpha, and
+    # clipping here would silently diverge from ss.push on out-of-range
+    # inputs (range enforcement belongs to the march, not the fold)
+    alpha = jnp.where(emp, 0.0, rgba[:, 3])
+    p = 1.0 - alpha
+    # exclusive within-slot transmittance: tiny sequential loop, 2 live
+    # [H,W] arrays (this is the only sequential dependence left, ~3 ops
+    # per slice; the prev_rgb update rides along for exact state parity)
+    t_run = jnp.ones((h, w), jnp.float32)
+    pr_run = st.prev_rgb
+    tls = []
+    for s in range(c):
+        t_here = jnp.where(reset[s], 1.0, t_run)
+        tls.append(t_here)
+        t_run = t_here * p[s]
+        pr_run = jnp.where(emp[s][None], pr_run, rgba[s, :3])
+    tl = jnp.stack(tls)                                    # [C, H, W]
+
+    live = tl * (~emp).astype(jnp.float32)
+    v = rgba * live[:, None]                               # [C, 4, H, W]
+
+    # K masked reductions; [K,...] state touched once per chunk. The merge
+    # is plain alpha-under: a slot continuing across the chunk boundary is
+    # scaled by (1 - out_alpha) == its carried transmittance; fresh slots
+    # have out_alpha == 0; untouched slots get contrib == 0.
+    out_c, out_s, out_e = [], [], []
+    for k in range(max_k):
+        m = (slot == k) & ~emp                             # [C, H, W]
+        mf = m.astype(jnp.float32)
+        contrib = jnp.sum(v * mf[:, None], axis=0)         # [4, H, W]
+        d0 = jnp.min(jnp.where(m, t0, jnp.inf), axis=0)
+        d1 = jnp.max(jnp.where(m, t1, -jnp.inf), axis=0)
+        oc = st.out_color[k]
+        out_c.append(oc + (1.0 - oc[3:4]) * contrib)
+        out_s.append(jnp.minimum(st.out_start[k], d0))
+        out_e.append(jnp.maximum(st.out_end[k], d1))
+
+    return SegFoldState(
+        out_color=jnp.stack(out_c),
+        out_start=jnp.stack(out_s),
+        out_end=jnp.stack(out_e),
+        cnt=st.cnt + jnp.sum(starts.astype(jnp.int32), axis=0),
+        prev_rgb=pr_run,
+        prev_empty=emp[-1],
+    )
+
+
+def seg_finalize(st: SegFoldState) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(color [K,4,H,W], depth [K,2,H,W]) in ``ss.finalize``'s format:
+    unused slots carry (+inf, +inf) depths and zero color."""
+    k = st.out_color.shape[0]
+    used = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0) < st.cnt[None]
+    depth = jnp.stack([jnp.where(used, st.out_start, jnp.inf),
+                       jnp.where(used, st.out_end, jnp.inf)], axis=1)
+    return st.out_color, depth

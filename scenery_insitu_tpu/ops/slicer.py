@@ -59,6 +59,8 @@ from scenery_insitu_tpu.core.transfer import TransferFunction
 from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
 from scenery_insitu_tpu.core.volume import Volume
 from scenery_insitu_tpu.ops import pallas_march as pm
+from scenery_insitu_tpu.ops import pallas_seg as psg
+from scenery_insitu_tpu.ops import seg_fold as sf
 from scenery_insitu_tpu.ops import supersegments as ss
 from scenery_insitu_tpu.ops.raycast import RaycastOutput, nominal_step
 from scenery_insitu_tpu.ops.sampling import adjust_opacity
@@ -83,7 +85,10 @@ class AxisSpec:
     matmul_dtype: str = "bf16"   # resampling matmul operand dtype
     s_floor: float = 1e-3     # min depth ratio: slices closer are dropped
     skip_empty: bool = True   # chunk_occupancy-based empty-space skipping
-    fold: str = "xla"         # supersegment-fold schedule: "xla" | "pallas"
+    # supersegment-fold schedule: "xla" (sequential machine, lax.scan) |
+    # "pallas" (round-3 two-phase machine kernel) | "seg" (round-4
+    # segmented-scan fold, ops/seg_fold.py) | "pallas_seg" (its VMEM twin)
+    fold: str = "xla"
 
     @property
     def u_axis(self) -> int:
@@ -137,19 +142,29 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     nj = rnd(dims_xyz[v_axis])
     fold = cfg.fold
     if fold == "auto":
-        # interpret-mode pallas is far slower than the XLA scan on CPU;
-        # on TPU a one-time Mosaic compile probe AT THIS SPEC'S frame
-        # width — which fixes the budget-capped BLOCK width and thus the
-        # exact kernel Mosaic sees (K probed at a conservative 32 —
-        # VDIConfig's K is not known here) — gates the kernel so a
-        # hardware/compiler rejection
-        # degrades to the XLA fold instead of failing inside a traced
-        # frame step (same pattern as the fused sim stencil's probe)
-        fold = ("pallas" if jax.default_backend() == "tpu"
-                and pm.fold_compile_ok(32, cfg.chunk, ni) else "xla")
-    if fold not in ("xla", "pallas"):
-        raise ValueError(f"unknown fold schedule {fold!r} "
-                         "(expected 'auto', 'xla' or 'pallas')")
+        # On TPU the default is the round-4 segmented-scan fold: the
+        # Pallas VMEM twin when a one-time Mosaic compile probe AT THIS
+        # SPEC'S frame width accepts it (the probe fixes the budget-capped
+        # BLOCK width and thus the exact kernel Mosaic sees; K probed at a
+        # conservative 32 — VDIConfig's K is not known here), else the
+        # pure-XLA seg schedule — still chunk-granular state traffic, no
+        # Mosaic exposure. On CPU the sequential machine wins (state
+        # lives in cache, and seg's K-masked reductions are real extra
+        # compute on a scalar core — measured 3x slower at 64x96^2), so
+        # tests and the virtual mesh keep "xla".
+        # BOTH kernels a pallas_seg spec can run must pass the probe: the
+        # write fold (pallas_seg.seg_fold_chunk) and the counting kernel
+        # the histogram/temporal-seed march uses (pm.count_multi_chunk) —
+        # a spec whose write kernel compiles but whose counting kernel is
+        # rejected would still fail inside initial_threshold()
+        if jax.default_backend() == "tpu":
+            fold = ("pallas_seg" if psg.seg_compile_ok(32, cfg.chunk, ni)
+                    and pm.count_compile_ok(32, cfg.chunk, ni) else "seg")
+        else:
+            fold = "xla"
+    if fold not in ("xla", "pallas", "seg", "pallas_seg"):
+        raise ValueError(f"unknown fold schedule {fold!r} (expected "
+                         "'auto', 'xla', 'pallas', 'seg' or 'pallas_seg')")
     return AxisSpec(axis=axis, sign=sign, ni=ni, nj=nj,
                     chunk=cfg.chunk, matmul_dtype=dtype,
                     s_floor=cfg.s_floor, skip_empty=cfg.skip_empty,
@@ -680,6 +695,15 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
 
         packed = march(consume, pm.init_packed(k, nj, ni))
         color, depth = ss.finalize(pm.unpack_state(packed))
+    elif spec.fold in ("seg", "pallas_seg"):
+        fold_fn = (psg.seg_fold_chunk if spec.fold == "pallas_seg"
+                   else sf.seg_fold_chunk)
+
+        def consume(st, rgba, t0, t1):
+            return fold_fn(st, rgba, t0, t1, threshold, max_k=k)
+
+        state = march(consume, sf.init_seg_state(k, nj, ni))
+        color, depth = sf.seg_finalize(state)
     else:
         def consume(st, rgba, t0, t1):
             for i in range(rgba.shape[0]):
@@ -712,7 +736,9 @@ def _histogram_threshold(march, cfg: VDIConfig, k: int, nj: int, ni: int,
     """One counting march for ALL candidate thresholds at once."""
     tvec = ss.threshold_candidates(cfg.histogram_bins, cfg.thr_max)
 
-    if fold == "pallas":
+    # any pallas fold implies a TPU backend where the VMEM counting
+    # kernel is also the right schedule for the histogram march
+    if fold.startswith("pallas"):
         def consume_multi(carry, rgba, t0, t1):
             return pm.count_multi_chunk(carry, rgba, tvec)
 
@@ -796,6 +822,21 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
             (pm.init_packed(k, nj, ni), jnp.zeros((nj, ni), jnp.int32)),
             u_bounds, v_bounds, occupancy=occ)
         color, depth = ss.finalize(pm.unpack_state(packed))
+    elif spec.fold in ("seg", "pallas_seg"):
+        # the segmented-scan fold's own running start count IS the true
+        # per-pixel segment count — the temporal controller's feedback
+        # signal comes out of the write fold for free
+        fold_fn = (psg.seg_fold_chunk if spec.fold == "pallas_seg"
+                   else sf.seg_fold_chunk)
+
+        def consume(st, rgba, t0, t1):
+            return fold_fn(st, rgba, t0, t1, thr, max_k=k)
+
+        state = slice_march(vol, tf, axcam, spec, consume,
+                            sf.init_seg_state(k, nj, ni),
+                            u_bounds, v_bounds, occupancy=occ)
+        color, depth = sf.seg_finalize(state)
+        count = state.cnt
     else:
         def consume(carry, rgba, t0, t1):
             st, cst = carry

@@ -1,0 +1,217 @@
+"""Parity tests for the segmented-scan write fold (ops/seg_fold.py): the
+parallel formulation must produce the same supersegments as sequential
+``ss.push`` calls — same break predicates, same merge-overflow, same
+depths — differing only in fp association of the within-segment sums."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scenery_insitu_tpu.ops import seg_fold as sf
+from scenery_insitu_tpu.ops import supersegments as ss
+
+
+def _stream(key, n, h, w, empty_frac=0.4, dup_frac=0.3):
+    """Depth-ordered stream with empty runs AND near-duplicate colors so
+    all three paths fire: start-on-gap, break-on-diff, accumulate."""
+    kr, ka, kd, ku = jax.random.split(key, 4)
+    rgb = jax.random.uniform(kr, (n, 3, h, w))
+    # near-duplicates: copy the previous item's color for ~dup_frac items
+    # so diff <= thr accumulation paths are exercised
+    dup = jax.random.uniform(ku, (n, 1, h, w)) < dup_frac
+    rgb = jnp.where(dup & (jnp.arange(n)[:, None, None, None] > 0),
+                    jnp.roll(rgb, 1, axis=0), rgb)
+    alpha = jax.random.uniform(ka, (n, 1, h, w), minval=0.05, maxval=0.9)
+    gate = jax.random.uniform(kd, (n, 1, h, w)) > empty_frac
+    alpha = alpha * gate
+    rgba = jnp.concatenate([rgb * alpha, alpha], axis=1)
+    t0 = jnp.cumsum(jnp.full((n, h, w), 0.1), axis=0)
+    return rgba, t0, t0 + 0.1
+
+
+def _ref(rgba, t0, t1, thr, max_k):
+    st = ss.init_state(max_k, rgba.shape[2], rgba.shape[3])
+    cst = ss.init_count(rgba.shape[2], rgba.shape[3])
+    for i in range(rgba.shape[0]):
+        st = ss.push(st, max_k, thr, rgba[i], t0[i], t1[i])
+        cst = ss.push_count(cst, thr, rgba[i])
+    c, d = ss.finalize(st)
+    return c, d, cst.count
+
+
+def _seg(rgba, t0, t1, thr, max_k, chunks):
+    st = sf.init_seg_state(max_k, rgba.shape[2], rgba.shape[3])
+    lo = 0
+    for c in chunks:
+        st = sf.seg_fold_chunk(st, rgba[lo:lo + c], t0[lo:lo + c],
+                               t1[lo:lo + c], thr, max_k=max_k)
+        lo += c
+    assert lo == rgba.shape[0]
+    c_, d_ = sf.seg_finalize(st)
+    return c_, d_, st.cnt
+
+
+@pytest.mark.parametrize("chunks", [(12,), (7, 5), (1,) * 12, (3, 3, 3, 3)])
+def test_matches_sequential_push(chunks):
+    h, w = 16, 40
+    max_k = 5
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(0), 12, h, w)
+    thr = jnp.full((h, w), 0.35, jnp.float32)
+    c_ref, d_ref, n_ref = _ref(rgba, t0, t1, thr, max_k)
+    c_s, d_s, n_s = _seg(rgba, t0, t1, thr, max_k, chunks)
+    np.testing.assert_array_equal(np.asarray(n_s), np.asarray(n_ref))
+    np.testing.assert_allclose(np.asarray(c_s), np.asarray(c_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_merge_overflow_parity():
+    """Threshold 0 forces a break at every color change -> far more true
+    segments than slots; the overflow tail must merge identically."""
+    h, w = 8, 24
+    max_k = 3
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(1), 20, h, w,
+                           empty_frac=0.25, dup_frac=0.0)
+    thr = jnp.zeros((h, w), jnp.float32)
+    c_ref, d_ref, n_ref = _ref(rgba, t0, t1, thr, max_k)
+    c_s, d_s, n_s = _seg(rgba, t0, t1, thr, max_k, (8, 12))
+    np.testing.assert_array_equal(np.asarray(n_s), np.asarray(n_ref))
+    np.testing.assert_allclose(np.asarray(c_s), np.asarray(c_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_all_empty_and_leading_empty_chunks():
+    h, w = 8, 16
+    max_k = 4
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(2), 10, h, w)
+    # force chunks 0-1 fully empty (the occupancy-skip path feeds exactly
+    # this: explicit empty samples that must close open segments)
+    rgba = rgba.at[:4].set(0.0)
+    thr = jnp.full((h, w), 0.3, jnp.float32)
+    c_ref, d_ref, n_ref = _ref(rgba, t0, t1, thr, max_k)
+    c_s, d_s, n_s = _seg(rgba, t0, t1, thr, max_k, (2, 2, 6))
+    np.testing.assert_array_equal(np.asarray(n_s), np.asarray(n_ref))
+    np.testing.assert_allclose(np.asarray(c_s), np.asarray(c_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gap_splits_segment_across_chunk_boundary():
+    """A segment open at a chunk boundary must continue (not restart):
+    composition across the boundary uses the carried out_alpha."""
+    h, w = 4, 8
+    max_k = 4
+    n = 6
+    # constant color, constant alpha, no empties: ONE segment
+    rgba = jnp.broadcast_to(
+        jnp.asarray([0.2, 0.1, 0.05, 0.5], jnp.float32)[None, :, None, None],
+        (n, 4, h, w))
+    t0 = jnp.cumsum(jnp.full((n, h, w), 0.1), axis=0)
+    thr = jnp.full((h, w), 0.5, jnp.float32)
+    c_ref, d_ref, n_ref = _ref(rgba, t0, t0 + 0.1, thr, max_k)
+    c_s, d_s, n_s = _seg(rgba, t0, t0 + 0.1, thr, max_k, (2, 2, 2))
+    assert int(n_s.max()) == 1
+    np.testing.assert_array_equal(np.asarray(n_s), np.asarray(n_ref))
+    np.testing.assert_allclose(np.asarray(c_s), np.asarray(c_ref),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_pallas_seg_matches_xla_seg():
+    """The VMEM twin (ops/pallas_seg.py, interpret mode off-TPU) must
+    reproduce the XLA seg fold including carried state across chunks."""
+    from scenery_insitu_tpu.ops import pallas_seg as psg
+
+    h, w = 16, 40                          # w deliberately NOT 128-aligned
+    max_k = 5
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(4), 12, h, w)
+    thr = jnp.full((h, w), 0.35, jnp.float32)
+    st_x = sf.init_seg_state(max_k, h, w)
+    st_p = sf.init_seg_state(max_k, h, w)
+    for lo, n in ((0, 7), (7, 5)):
+        st_x = sf.seg_fold_chunk(st_x, rgba[lo:lo + n], t0[lo:lo + n],
+                                 t1[lo:lo + n], thr, max_k=max_k)
+        st_p = psg.seg_fold_chunk(st_p, rgba[lo:lo + n], t0[lo:lo + n],
+                                  t1[lo:lo + n], thr, max_k=max_k)
+    np.testing.assert_array_equal(np.asarray(st_p.cnt), np.asarray(st_x.cnt))
+    for a, b, name in zip(sf.seg_finalize(st_x), sf.seg_finalize(st_p),
+                          ("color", "depth")):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-6, atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize("fold", ["seg", "pallas_seg"])
+def test_whole_march_parity(fold):
+    """generate_vdi_mxu + temporal: the seg folds must reproduce the
+    sequential-machine fold end to end, including the temporal threshold
+    controller's feedback (integer counts must agree exactly)."""
+    from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import procedural_volume
+    from scenery_insitu_tpu.ops import slicer
+
+    vol = procedural_volume(40, kind="blobs", seed=7)
+    tf = for_dataset("procedural")
+    cam = Camera.create((0.25, 0.5, 2.6), fov_y_deg=45.0, near=0.3,
+                        far=10.0)
+    cfg = VDIConfig(max_supersegments=6, adaptive_mode="histogram",
+                    histogram_bins=8)
+    spec_x = slicer.make_spec(cam, vol.data.shape,
+                              SliceMarchConfig(matmul_dtype="f32",
+                                               scale=1.5, fold="xla"))
+    spec_s = slicer.make_spec(cam, vol.data.shape,
+                              SliceMarchConfig(matmul_dtype="f32",
+                                               scale=1.5, fold=fold))
+    vdi_x, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec_x, cfg)
+    vdi_s, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec_s, cfg)
+    np.testing.assert_allclose(np.asarray(vdi_s.color),
+                               np.asarray(vdi_x.color),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(vdi_s.depth),
+                               np.asarray(vdi_x.depth),
+                               rtol=1e-5, atol=1e-5)
+
+    cfg_t = VDIConfig(max_supersegments=6, adaptive_mode="temporal")
+    thr_x = slicer.initial_threshold(vol, tf, cam, spec_x, cfg_t)
+    thr_s = slicer.initial_threshold(vol, tf, cam, spec_s, cfg_t)
+    for _ in range(2):
+        vdi_x, _, _, thr_x = slicer.generate_vdi_mxu_temporal(
+            vol, tf, cam, spec_x, thr_x, cfg_t)
+        vdi_s, _, _, thr_s = slicer.generate_vdi_mxu_temporal(
+            vol, tf, cam, spec_s, thr_s, cfg_t)
+        np.testing.assert_allclose(np.asarray(vdi_s.color),
+                                   np.asarray(vdi_x.color),
+                                   rtol=1e-5, atol=1e-5)
+        # thresholds bisect from identical integer counts -> exact
+        np.testing.assert_allclose(np.asarray(thr_s.thr),
+                                   np.asarray(thr_x.thr),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_scalar_threshold_and_jit():
+    h, w = 8, 16
+    max_k = 4
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(3), 8, h, w)
+    c_ref, d_ref, n_ref = _ref(rgba, t0, t1,
+                               jnp.full((h, w), 0.4, jnp.float32), max_k)
+
+    @jax.jit
+    def run(rgba, t0, t1):
+        st = sf.init_seg_state(max_k, h, w)
+        st = sf.seg_fold_chunk(st, rgba, t0, t1, 0.4, max_k=max_k)
+        c, d = sf.seg_finalize(st)
+        return c, d, st.cnt
+
+    c_s, d_s, n_s = run(rgba, t0, t1)
+    np.testing.assert_array_equal(np.asarray(n_s), np.asarray(n_ref))
+    np.testing.assert_allclose(np.asarray(c_s), np.asarray(c_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
+                               rtol=1e-5, atol=1e-5)

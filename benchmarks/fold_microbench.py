@@ -11,6 +11,11 @@ for each schedule:
 
   xla          lax.scan over chunks, C sequential ss.push per chunk
                (ops/slicer.py generate_vdi_mxu fold="xla")
+  seg          round-4 segmented-scan fold, pure XLA (ops/seg_fold.py,
+               fold="seg"): start flags / ids / transmittance parallel,
+               K-state touched once per chunk
+  pallas_seg   the seg fold's VMEM pixel-strip twin (ops/pallas_seg.py,
+               fold="pallas_seg" — the round-4 TPU default)
   pallas       pm.fold_chunk per chunk (fold="pallas") — since the
                two-phase rewrite this IS the events schedule with a
                rolled phase 2
@@ -42,6 +47,8 @@ import jax
 import jax.numpy as jnp
 
 from scenery_insitu_tpu.ops import pallas_march as pm
+from scenery_insitu_tpu.ops import pallas_seg as psg
+from scenery_insitu_tpu.ops import seg_fold as sfold
 from scenery_insitu_tpu.ops import supersegments as ss
 
 
@@ -158,6 +165,15 @@ def _events_kernel(rgba_ref, td_ref, thr_ref,
         do_[kk, 1] = jnp.where(hit, acc_e, di_[kk, 1])
 
 
+def _fpp_events(c: int, k: int) -> int:
+    """Per-pixel-column VMEM estimate shared by the events/scratch twins:
+    in+out blocks double-buffered + the 7xC event records (SSA or scratch)
+    + phase-1 slack — the same formula the production kernel budgets with,
+    so the twins width-tile to comparable geometry instead of OOMing
+    Mosaic's scoped VMEM at full-width 512-scale strips."""
+    return 2 * 2 * (6 * c + 1 + 6 * max(k, pm._EST_K) + 12) + 7 * c + 64
+
+
 def events_fold_chunk(big, small, rgba, t0, t1, threshold, *, max_k: int,
                       tile_h: int = 8):
     """Driver for `_events_kernel`: big = (color [K,4,H,W], depth
@@ -170,12 +186,13 @@ def events_fold_chunk(big, small, rgba, t0, t1, threshold, *, max_k: int,
     c = rgba.shape[0]
     threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
     td = jnp.stack([t0, t1], axis=1)
-    row = lambda *lead: pl.BlockSpec(lead + (tile_h, w),
-                                     lambda j: (0,) * len(lead) + (j, 0))
     kk = color.shape[0]
+    wb = pm._pick_block_w(w, 4 * tile_h * _fpp_events(c, kk))
+    row = lambda *lead: pl.BlockSpec(lead + (tile_h, wb),
+                                     lambda j, i: (0,) * len(lead) + (j, i))
     out = pl.pallas_call(
         functools.partial(_events_kernel, max_k=max_k),
-        grid=(h // tile_h,),
+        grid=(h // tile_h, pl.cdiv(w, wb)),
         in_specs=[row(c, 4), row(c, 2), row(),
                   row(kk, 4), row(kk, 2), row(12)],
         out_specs=[row(kk, 4), row(kk, 2), row(12)],
@@ -278,19 +295,20 @@ def scratch_fold_chunk(big, small, rgba, t0, t1, threshold, *,
     c = rgba.shape[0]
     threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
     td = jnp.stack([t0, t1], axis=1)
-    row = lambda *lead: pl.BlockSpec(lead + (tile_h, w),
-                                     lambda j: (0,) * len(lead) + (j, 0))
     kk = color.shape[0]
+    wb = pm._pick_block_w(w, 4 * tile_h * _fpp_events(c, kk))
+    row = lambda *lead: pl.BlockSpec(lead + (tile_h, wb),
+                                     lambda j, i: (0,) * len(lead) + (j, i))
     out = pl.pallas_call(
         functools.partial(_scratch_kernel, max_k=max_k),
-        grid=(h // tile_h,),
+        grid=(h // tile_h, pl.cdiv(w, wb)),
         in_specs=[row(c, 4), row(c, 2), row(),
                   row(kk, 4), row(kk, 2), row(12)],
         out_specs=[row(kk, 4), row(kk, 2), row(12)],
         out_shape=[jax.ShapeDtypeStruct(color.shape, jnp.float32),
                    jax.ShapeDtypeStruct(depth.shape, jnp.float32),
                    jax.ShapeDtypeStruct((12, h, w), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((c, 7, tile_h, w), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((c, 7, tile_h, wb), jnp.float32)],
         input_output_aliases={3: 0, 4: 1, 5: 2},
         interpret=pm.should_interpret(),
     )(rgba, td, threshold, color, depth, small)
@@ -330,6 +348,25 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
             st, _ = jax.lax.scan(body, ss.init_state(k, h, w),
                                  jnp.arange(nchunks))
             return ss.finalize(st)
+    elif variant == "seg":
+        def run():
+            def body(st, ci):
+                rgba, t0, t1 = stream_chunk(ci, c, h, w)
+                return sfold.seg_fold_chunk(st, rgba, t0, t1, thr,
+                                            max_k=k), None
+            st, _ = jax.lax.scan(body, sfold.init_seg_state(k, h, w),
+                                 jnp.arange(nchunks))
+            return sfold.seg_finalize(st)
+    elif variant == "pallas_seg":
+        def run():
+            # packed carry — the production schedule (see slicer)
+            def body(packed, ci):
+                rgba, t0, t1 = stream_chunk(ci, c, h, w)
+                return psg.fold_chunk_packed(packed, rgba, t0, t1, thr,
+                                             max_k=k), None
+            packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
+                                     jnp.arange(nchunks))
+            return sfold.seg_finalize(psg.unpack_seg_state(packed))
     elif variant.startswith("pallas"):
         # pallas_tN: strip height N; pallas_wN: block width N (the
         # production kernel picks width by VMEM budget — see
@@ -352,30 +389,35 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
                                  "pallas_tN or pallas_wN)")
 
         def run():
+            # snapshot BEFORE any mutation, mutate only inside the try:
+            # an exception anywhere (incl. the force_w computation) must
+            # not leak overrides into later variants of the sweep
             old = pm.TILE_H
             old_w = pm._FORCE_BLOCK_W
             old_g = pm._PHASE2_GATED
-            pm._PHASE2_GATED = gated
-            force_w = wblk
-            if tile is not None:
-                pm.TILE_H = tile
-                if force_w is None:
-                    # pin the block width to the DEFAULT geometry's choice
-                    # (the budget-driven pick scales with strip height, so
-                    # without this a t-sweep would also narrow the blocks
-                    # and confound the two geometry axes) — clamped to
-                    # what the budget allows AT the forced height, else a
-                    # taller strip at the default width would blow the
-                    # scoped-VMEM limit outright; when the clamp engages,
-                    # compare against the matching pallas_wN row for the
-                    # controlled same-width height comparison
-                    fpp = (2 * 2 * (6 * c + 1 + 6 * max(k, pm._EST_K)
-                                    + 12 + 1) + 7 * c + 64)
-                    force_w = min(pm._pick_block_w(w, 4 * 8 * fpp),
-                                  pm._pick_block_w(w, 4 * tile * fpp))
-            if force_w is not None:
-                pm._FORCE_BLOCK_W = force_w
             try:
+                pm._PHASE2_GATED = gated
+                force_w = wblk
+                if tile is not None:
+                    pm.TILE_H = tile
+                    if force_w is None:
+                        # pin the block width to the DEFAULT geometry's
+                        # choice (the budget-driven pick scales with strip
+                        # height, so without this a t-sweep would also
+                        # narrow the blocks and confound the two geometry
+                        # axes) — clamped to what the budget allows AT the
+                        # forced height, else a taller strip at the
+                        # default width would blow the scoped-VMEM limit
+                        # outright; when the clamp engages, compare
+                        # against the matching pallas_wN row for the
+                        # controlled same-width height comparison
+                        fpp = (2 * 2 * (6 * c + 1 + 6 * max(k, pm._EST_K)
+                                        + 12 + 1) + 7 * c + 64)
+                        force_w = min(pm._pick_block_w(w, 4 * 8 * fpp),
+                                      pm._pick_block_w(w, 4 * tile * fpp))
+                if force_w is not None:
+                    pm._FORCE_BLOCK_W = force_w
+
                 def body(packed, ci):
                     rgba, t0, t1 = stream_chunk(ci, c, h, w)
                     return pm.fold_chunk(packed, rgba, t0, t1, thr,
@@ -456,28 +498,39 @@ def main():
           f"S={s_total} HxW={h}x{w} K={args.k} C={args.chunk}",
           file=sys.stderr, flush=True)
 
+    timed_variants = [v.strip() for v in args.variants.split(",")]
     if args.check:
         import numpy as np
         ref = jax.jit(build("xla", s_total, args.chunk, args.k, h, w))()
         # every requested fold-producing variant (anything but the xla
         # reference and the non-folding floors) must match the xla fold —
         # a geometry/schedule variant with wrong numerics must not get
-        # its timing recorded as a valid datapoint
-        check_variants = [v.strip() for v in args.variants.split(",")
-                          if v.strip() not in ("xla", "count", "none")]
-        for v in check_variants or ("pallas", "events"):
-            got = jax.jit(build(v, s_total, args.chunk, args.k, h, w))()
-            for a, b, name in [(ref[0], got[0], "color"),
-                               (ref[1], got[1], "depth")]:
-                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                           rtol=1e-5, atol=1e-5,
-                                           err_msg=f"{v} {name}")
-        print("[fold_microbench] parity check passed "
-              f"({', '.join(check_variants or ('pallas', 'events'))})",
-              file=sys.stderr, flush=True)
+        # its timing recorded as a valid datapoint. Each check is guarded
+        # PER VARIANT: one compile rejection / mismatch emits an error
+        # row and drops only that variant from the timing loop, instead
+        # of aborting before ANY timing is printed (a hardware window
+        # must never lose the whole sweep to one bad variant).
+        passed, failed = [], []
+        for v in [x for x in timed_variants
+                  if x not in ("xla", "count", "none")]:
+            try:
+                got = jax.jit(build(v, s_total, args.chunk, args.k, h, w))()
+                for a, b, name in [(ref[0], got[0], "color"),
+                                   (ref[1], got[1], "depth")]:
+                    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                               rtol=1e-5, atol=1e-5,
+                                               err_msg=f"{v} {name}")
+                passed.append(v)
+            except Exception as e:
+                failed.append(v)
+                print(json.dumps({"variant": v, "error":
+                                  f"check: {type(e).__name__}: {e}"[:300]}),
+                      flush=True)
+        timed_variants = [v for v in timed_variants if v not in failed]
+        print(f"[fold_microbench] parity check: passed={passed} "
+              f"failed={failed}", file=sys.stderr, flush=True)
 
-    for variant in args.variants.split(","):
-        variant = variant.strip()
+    for variant in timed_variants:
         try:
             run = jax.jit(build(variant, s_total, args.chunk, args.k, h, w))
             t_c = time.perf_counter()

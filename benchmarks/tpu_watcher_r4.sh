@@ -1,0 +1,162 @@
+#!/bin/bash
+# Round-4 watcher. Same resumable skeleton as tpu_watcher_r3c.sh (probe
+# before EVERY step, output file = done marker, fail-bench after MAXFAIL
+# tunnel-alive failures) with the round-4 queue: the segmented-scan fold
+# measurements lead — they decide whether the round's redesign killed the
+# ~390 ms write-fold overhead (VERDICT round 3, item 1) — then the 512^3
+# flagship re-measure, the march-stage profile (item 2), the controlled
+# 256^3 round-2 A/B (item 6), chunk sweeps, the 1024^3 attempt (item 3),
+# and the round-3 diagnostics that never got a window.
+# Log: /tmp/tpu_watcher_r4.log
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p benchmarks/results
+R=benchmarks/results
+L=/tmp/tpu_watcher_r4.log
+
+probe() {
+  timeout 120 python - <<'EOF' 2>/dev/null
+import jax
+assert jax.devices()[0].platform == "tpu"
+import jax.numpy as jnp
+assert float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()) > 0
+EOF
+}
+
+run_json() {
+  local out="$1" tmo="$2"; shift 2
+  if timeout "$tmo" "$@" > "$out.full.tmp" 2>>"$L" \
+     && tail -1 "$out.full.tmp" > "$out.tmp" \
+     && python -c "import json,sys; json.load(open(sys.argv[1]))" \
+          "$out.tmp" 2>>"$L"; then
+    mv "$out.tmp" "$out"; rm -f "$out.full.tmp"
+    echo "ok: $out $(date -u +%H:%M:%S)" >> "$L"
+    cat "$out"
+  else
+    rm -f "$out.tmp" "$out.full.tmp"
+    echo "FAILED: $out $(date -u +%H:%M:%S)" >> "$L"
+  fi
+}
+
+run_jsonl() {
+  local out="$1" tmo="$2"; shift 2
+  if timeout "$tmo" "$@" > "$out.tmp" 2>>"$L"; then
+    mv "$out.tmp" "$out"; echo "ok: $out $(date -u +%H:%M:%S)" >> "$L"
+    cat "$out"
+  else
+    if [ -s "$out.tmp" ]; then mv "$out.tmp" "$out.partial"; fi
+    rm -f "$out.tmp"; echo "FAILED: $out $(date -u +%H:%M:%S)" >> "$L"
+  fi
+}
+
+run_step() {  # run_step <n>
+  case "$1" in
+    # 1: THE round-4 measurement — every fold schedule head to head at
+    # the flagship 512 scale, parity-checked (per-variant guarded).
+    1) run_jsonl "$R/fold_microbench_512_seg_r4.jsonl" 2400 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --variants none,count,xla,seg,pallas_seg,pallas ;;
+    # 2: flagship 512^3 with the new default fold (auto -> pallas_seg)
+    2) run_json "$R/bench_tpu_r4_512.json" 1000 env \
+         SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
+         python bench.py ;;
+    # 3: same flagship on the pure-XLA seg fold (Mosaic-free A/B)
+    3) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 4: 256-scale microbench — directly comparable to the committed
+    # round-3 numbers (xla 15.4 / two-phase pallas 16.0 ms per march)
+    4) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
+         python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
+         --variants none,count,xla,seg,pallas_seg,pallas ;;
+    # 5: march-stage profile at the flagship scale (VERDICT item 2: where
+    # do the ~34 counting-march ms go — einsums, TF, opacity, fold?)
+    5) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
+         python -u benchmarks/profile_march.py 512 ;;
+    # 6: controlled 256^3 A/B vs round 2 (VERDICT item 6): exact round-2
+    # config — histogram mode, xla fold, chunk 16, 25 frames — on the
+    # round-4 build; compare against bench_tpu_2026-07-30_25frames.json
+    6) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
+         SITPU_BENCH_GRID=256 SITPU_BENCH_ADAPTIVE_MODE=histogram \
+         SITPU_BENCH_FOLD=xla SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 7: same config, temporal + new fold — the mode/fold deltas at 256
+    7) run_json "$R/bench_tpu_r4_256.json" 900 env \
+         SITPU_BENCH_GRID=256 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 8: chunk sweep for the seg folds (state traffic halves per doubling;
+    # einsum batches grow) at 512
+    8) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --chunk 32 --variants xla,seg,pallas_seg ;;
+    9) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --chunk 64 --variants seg,pallas_seg ;;
+    # 10: flagship at chunk 32 if the sweep says it matters
+    10) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
+         SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 11: the 1024^3 north-star attempt (VERDICT item 3) — bf16 sim state
+    # + donation; a diagnosed OOM is also a result
+    11) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
+         SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
+         python bench.py ;;
+    # 12-15: round-3 diagnostics that never got a window
+    12) run_json "$R/novel_view_tpu_r4.json" 1500 \
+         python benchmarks/novel_view_bench.py --iters 3 ;;
+    13) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
+         python benchmarks/composite_bench.py ;;
+    14) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
+         python benchmarks/scaling_bench.py --grid 128 --frames 10 ;;
+    15) run_json "$R/profile_frame_tpu_r4.json" 1200 \
+         python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
+  esac
+}
+
+step_out() {
+  case "$1" in
+    1) echo "$R/fold_microbench_512_seg_r4.jsonl" ;;
+    2) echo "$R/bench_tpu_r4_512.json" ;;
+    3) echo "$R/bench_tpu_r4_512_segxla.json" ;;
+    4) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
+    5) echo "$R/profile_march_512_r4.txt" ;;
+    6) echo "$R/bench_tpu_r4_256_r2config.json" ;;
+    7) echo "$R/bench_tpu_r4_256.json" ;;
+    8) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
+    9) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
+    10) echo "$R/bench_tpu_r4_512_c32.json" ;;
+    11) echo "$R/bench_tpu_r4_1024.json" ;;
+    12) echo "$R/novel_view_tpu_r4.json" ;;
+    13) echo "$R/composite_tpu_r4.json" ;;
+    14) echo "$R/scaling_tpu_r4.json" ;;
+    15) echo "$R/profile_frame_tpu_r4.json" ;;
+  esac
+}
+
+NSTEPS=15
+MAXFAIL=2
+for i in $(seq 1 500); do
+  next=""
+  for s in $(seq 1 $NSTEPS); do
+    fails=$(cat "/tmp/r4_fail.$s" 2>/dev/null || echo 0)
+    [ -e "$(step_out "$s")" ] || [ "$fails" -ge $MAXFAIL ] \
+      || { next="$s"; break; }
+  done
+  [ -z "$next" ] && { echo "suite done $(date -u)" >> "$L"; exit 0; }
+  if probe; then
+    echo "tunnel alive $(date -u +%H:%M:%S), step $next" | tee -a "$L"
+    date -u >> "$R/tpu_alive_r4.marker"
+    run_step "$next"
+    if [ -e "$(step_out "$next")" ]; then
+      rm -f "/tmp/r4_fail.$next"
+    elif probe; then
+      fails=$(cat "/tmp/r4_fail.$next" 2>/dev/null || echo 0)
+      echo $((fails + 1)) > "/tmp/r4_fail.$next"
+      echo "fail $((fails + 1))/$MAXFAIL for step $next (tunnel alive)" \
+        >> "$L"
+    fi
+  else
+    echo "tunnel dead $(date -u +%H:%M:%S), step $next pending" >> "$L"
+    sleep 45
+  fi
+done

@@ -275,7 +275,13 @@ def _fold_kernel(*refs, max_k: int, gap_eps: float, with_count: bool):
     if _PHASE2_GATED:
         # a row with no event anywhere in the block only needs the
         # passthrough copy (the out block must still be fully written —
-        # it is a fresh VMEM buffer, not the input)
+        # it is a fresh VMEM buffer, not the input). NOTE: the jnp.any
+        # reduces over the WHOLE block including the masked lane padding
+        # of a partial last block on hardware; garbage in the padding can
+        # only flip the gate CONSERVATIVELY true (extract where a copy
+        # would do — correct, just slower), so a flat gated-vs-ungated
+        # hardware result on non-128-multiple widths must not be misread
+        # as the gate being worthless. Untestable in interpret mode.
         def slot_body(kk, _):
             kf = kk.astype(jnp.float32)
             row_has_event = jnp.any(ev_slot == kf)
@@ -433,20 +439,22 @@ _COUNT_PROBE: dict = {}
 def count_compile_ok(bins: int = 32, chunk: int = 16,
                      width: int = 2048) -> bool:
     """One-time Mosaic-acceptance probe for the COUNTING kernel
-    (`count_multi_chunk`) at the real (bins<= _EST_B, chunk, width)
+    (`count_multi_chunk`) at the real (chunk, width) geometry
     geometry. The round-4 "auto" fold resolution requires this alongside
     the write-fold probe before selecting a pallas schedule: the
     histogram/temporal-seed counting march runs this kernel, and a
     rejection must degrade to the XLA counting scan in `make_spec`, not
-    fail inside a traced frame step. Probed at _EST_B bins, which (via
-    the bins floor in the kernel's block-width estimate) is the exact
-    geometry every bins <= _EST_B compiles to."""
-    key = (jax.default_backend(), int(min(bins, _EST_B)), int(chunk),
+    fail inside a traced frame step. Probed at max(bins, _EST_B): the
+    bins floor in the kernel's block-width estimate pins the block
+    geometry for every bins <= _EST_B to what the _EST_B probe
+    exercises (conservative direction — the probe's kernel is the
+    bigger one), and bins > _EST_B probe at their real size."""
+    key = (jax.default_backend(), int(max(bins, _EST_B)), int(chunk),
            int(width))
     ok = _COUNT_PROBE.get(key)
     if ok is None:
         try:
-            b, c, h, w = int(min(bins, _EST_B)), int(chunk), TILE_H, \
+            b, c, h, w = int(max(bins, _EST_B)), int(chunk), TILE_H, \
                 int(width)
             sds = jax.ShapeDtypeStruct
 

@@ -51,6 +51,21 @@ _NSMALL = 5
 _EST_K = 32
 
 
+def init_seg_packed(k: int, height: int, width: int):
+    """Packed fold state ≅ seg_fold.init_seg_state — built directly in
+    packed layout so a march can carry the triple through its scan with
+    no per-chunk stack/concat traffic (the depth plane alone is
+    [K,2,H,W]; re-materializing it every chunk would cost more HBM than
+    the kernel's own state pass)."""
+    color = jnp.zeros((k, 4, height, width), jnp.float32)
+    depth = jnp.stack([
+        jnp.full((k, height, width), jnp.inf, jnp.float32),
+        jnp.full((k, height, width), -jnp.inf, jnp.float32)], axis=1)
+    small = jnp.zeros((_NSMALL, height, width), jnp.float32)
+    small = small.at[_PREV_EMPTY].set(1.0)
+    return (color, depth, small)
+
+
 def pack_seg_state(st: sf.SegFoldState):
     small = jnp.concatenate([
         st.cnt.astype(jnp.float32)[None],
@@ -126,13 +141,18 @@ def _floats_per_px(c: int, k: int) -> int:
     return 2 * 2 * (6 * c + 1 + 6 * max(k, _EST_K) + _NSMALL) + 5 * c + 64
 
 
-def seg_fold_chunk(st: sf.SegFoldState, rgba: jnp.ndarray, t0: jnp.ndarray,
-                   t1: jnp.ndarray, threshold: jnp.ndarray, *, max_k: int,
-                   interpret: Optional[bool] = None) -> sf.SegFoldState:
-    """Drop-in twin of ``seg_fold.seg_fold_chunk`` on VMEM pixel strips."""
+def fold_chunk_packed(packed, rgba: jnp.ndarray, t0: jnp.ndarray,
+                      t1: jnp.ndarray, threshold: jnp.ndarray, *,
+                      max_k: int, interpret: Optional[bool] = None):
+    """Fold one chunk on VMEM pixel strips, packed-state in/out.
+
+    ``packed`` is the `init_seg_packed` triple; carrying it through the
+    march's scan keeps the [K,...] state layout stable across chunks so
+    ``input_output_aliases`` updates it in place — no per-chunk
+    stack/slice re-materialization. Semantics = seg_fold.seg_fold_chunk.
+    """
     if interpret is None:
         interpret = should_interpret()
-    packed = pack_seg_state(st)
     color, depth, small = packed
     kk = color.shape[0]
     _, _, h, w = color.shape
@@ -157,7 +177,20 @@ def seg_fold_chunk(st: sf.SegFoldState, rgba: jnp.ndarray, t0: jnp.ndarray,
         input_output_aliases={3: 0, 4: 1, 5: 2},
         interpret=interpret,
     )(rgba, td, threshold, *packed)
-    return unpack_seg_state(tuple(out))
+    return tuple(out)
+
+
+def seg_fold_chunk(st: sf.SegFoldState, rgba: jnp.ndarray, t0: jnp.ndarray,
+                   t1: jnp.ndarray, threshold: jnp.ndarray, *, max_k: int,
+                   interpret: Optional[bool] = None) -> sf.SegFoldState:
+    """Drop-in twin of ``seg_fold.seg_fold_chunk`` (NamedTuple in/out).
+    Convenience for tests/small streams — production marches carry the
+    packed triple via `init_seg_packed` + `fold_chunk_packed` instead,
+    avoiding the pack/unpack copies this wrapper pays per call."""
+    packed = pack_seg_state(st)
+    out = fold_chunk_packed(packed, rgba, t0, t1, threshold, max_k=max_k,
+                            interpret=interpret)
+    return unpack_seg_state(out)
 
 
 # ------------------------------------------------------------ compile probe

@@ -695,12 +695,20 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
 
         packed = march(consume, pm.init_packed(k, nj, ni))
         color, depth = ss.finalize(pm.unpack_state(packed))
-    elif spec.fold in ("seg", "pallas_seg"):
-        fold_fn = (psg.seg_fold_chunk if spec.fold == "pallas_seg"
-                   else sf.seg_fold_chunk)
+    elif spec.fold == "pallas_seg":
+        # packed-carry: the [K,...] state keeps one layout across the
+        # whole scan so the kernel's input_output_aliases update it in
+        # place (a NamedTuple carry would pay a stack/slice copy of the
+        # depth plane per chunk)
+        def consume(packed, rgba, t0, t1):
+            return psg.fold_chunk_packed(packed, rgba, t0, t1, threshold,
+                                         max_k=k)
 
+        packed = march(consume, psg.init_seg_packed(k, nj, ni))
+        color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
+    elif spec.fold == "seg":
         def consume(st, rgba, t0, t1):
-            return fold_fn(st, rgba, t0, t1, threshold, max_k=k)
+            return sf.seg_fold_chunk(st, rgba, t0, t1, threshold, max_k=k)
 
         state = march(consume, sf.init_seg_state(k, nj, ni))
         color, depth = sf.seg_finalize(state)
@@ -826,15 +834,22 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         # the segmented-scan fold's own running start count IS the true
         # per-pixel segment count — the temporal controller's feedback
         # signal comes out of the write fold for free
-        fold_fn = (psg.seg_fold_chunk if spec.fold == "pallas_seg"
-                   else sf.seg_fold_chunk)
+        if spec.fold == "pallas_seg":
+            def consume(packed, rgba, t0, t1):
+                return psg.fold_chunk_packed(packed, rgba, t0, t1, thr,
+                                             max_k=k)
 
-        def consume(st, rgba, t0, t1):
-            return fold_fn(st, rgba, t0, t1, thr, max_k=k)
+            packed = slice_march(vol, tf, axcam, spec, consume,
+                                 psg.init_seg_packed(k, nj, ni),
+                                 u_bounds, v_bounds, occupancy=occ)
+            state = psg.unpack_seg_state(packed)
+        else:
+            def consume(st, rgba, t0, t1):
+                return sf.seg_fold_chunk(st, rgba, t0, t1, thr, max_k=k)
 
-        state = slice_march(vol, tf, axcam, spec, consume,
-                            sf.init_seg_state(k, nj, ni),
-                            u_bounds, v_bounds, occupancy=occ)
+            state = slice_march(vol, tf, axcam, spec, consume,
+                                sf.init_seg_state(k, nj, ni),
+                                u_bounds, v_bounds, occupancy=occ)
         color, depth = sf.seg_finalize(state)
         count = state.cnt
     else:

@@ -166,12 +166,11 @@ def _events_kernel(rgba_ref, td_ref, thr_ref,
 
 
 def _fpp_events(c: int, k: int) -> int:
-    """Per-pixel-column VMEM estimate shared by the events/scratch twins:
-    in+out blocks double-buffered + the 7xC event records (SSA or scratch)
-    + phase-1 slack — the same formula the production kernel budgets with,
-    so the twins width-tile to comparable geometry instead of OOMing
-    Mosaic's scoped VMEM at full-width 512-scale strips."""
-    return 2 * 2 * (6 * c + 1 + 6 * max(k, pm._EST_K) + 12) + 7 * c + 64
+    """Per-pixel-column VMEM estimate for the events/scratch twins: the
+    shared production budget (pm.strip_fpp) minus the count plane the
+    twins don't carry — so they width-tile to comparable geometry
+    instead of OOMing Mosaic's scoped VMEM at full-width 512 strips."""
+    return pm.strip_fpp(c, k, count_plane=False)
 
 
 def events_fold_chunk(big, small, rgba, t0, t1, threshold, *, max_k: int,
@@ -411,8 +410,7 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
                         # outright; when the clamp engages, compare
                         # against the matching pallas_wN row for the
                         # controlled same-width height comparison
-                        fpp = (2 * 2 * (6 * c + 1 + 6 * max(k, pm._EST_K)
-                                        + 12 + 1) + 7 * c + 64)
+                        fpp = pm.strip_fpp(c, k)
                         force_w = min(pm._pick_block_w(w, 4 * 8 * fpp),
                                       pm._pick_block_w(w, 4 * tile * fpp))
                 if force_w is not None:

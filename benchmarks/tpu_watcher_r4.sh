@@ -28,10 +28,13 @@ run_json() {
      && tail -1 "$out.full.tmp" > "$out.tmp" \
      && python -c "import json,sys; json.load(open(sys.argv[1]))" \
           "$out.tmp" 2>>"$L"; then
-    mv "$out.tmp" "$out"; rm -f "$out.full.tmp"
+    mv "$out.tmp" "$out"; rm -f "$out.full.tmp" "$out.failed"
     echo "ok: $out $(date -u +%H:%M:%S)" >> "$L"
     cat "$out"
   else
+    # keep the full stdout of a failed step — a 30-minute hardware
+    # window must never end with nothing to diagnose
+    if [ -s "$out.full.tmp" ]; then mv "$out.full.tmp" "$out.failed"; fi
     rm -f "$out.tmp" "$out.full.tmp"
     echo "FAILED: $out $(date -u +%H:%M:%S)" >> "$L"
   fi
@@ -95,8 +98,9 @@ run_step() {  # run_step <n>
     10) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
          SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 11: the 1024^3 north-star attempt (VERDICT item 3) — bf16 sim state
-    # + donation; a diagnosed OOM is also a result
+    # 11: the 1024^3 north-star attempt (VERDICT item 3) — f32 sim state
+    # (donated) + bf16 RENDER copy (bench.py render_dtype defaults to
+    # bf16 at grid>=1024); a diagnosed OOM is also a result
     11) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
          SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \

@@ -28,9 +28,19 @@ class Volume(NamedTuple):
     origin: jnp.ndarray    # f32[3] world position of min corner (x, y, z)
     spacing: jnp.ndarray   # f32[3] world size of a voxel (x, y, z)
 
+    @staticmethod
+    def _field_dtype(data):
+        """Everything normalizes to f32 EXCEPT bf16, which is preserved:
+        a bf16 field is the deliberate memory plan of very large volumes
+        (the 1024^3 march's permuted copy halves; the resampling einsum
+        casts to bf16 anyway — see models/pipelines.py render_dtype)."""
+        if getattr(data, "dtype", None) == jnp.bfloat16:
+            return jnp.bfloat16
+        return jnp.float32
+
     @classmethod
     def create(cls, data, origin=(0.0, 0.0, 0.0), spacing=(1.0, 1.0, 1.0)) -> "Volume":
-        return cls(jnp.asarray(data, jnp.float32),
+        return cls(jnp.asarray(data, cls._field_dtype(data)),
                    jnp.asarray(origin, jnp.float32),
                    jnp.asarray(spacing, jnp.float32))
 
@@ -38,7 +48,7 @@ class Volume(NamedTuple):
     def centered(cls, data, extent: float = 2.0) -> "Volume":
         """Place the volume centered at the world origin with its largest side
         spanning `extent` world units."""
-        data = jnp.asarray(data, jnp.float32)
+        data = jnp.asarray(data, cls._field_dtype(data))
         d, h, w = data.shape
         vox = extent / max(d, h, w)
         size = jnp.array([w * vox, h * vox, d * vox], jnp.float32)

@@ -8,6 +8,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
 from scenery_insitu_tpu.core.camera import Camera
@@ -29,7 +30,7 @@ def grayscott_vdi_frame_step(width: int, height: int,
                              fov_y_deg: float = 50.0,
                              engine: str = "auto",
                              grid_shape=None, axis_sign=None,
-                             slicer_cfg=None):
+                             slicer_cfg=None, render_dtype: str = "f32"):
     """Single-chip in-situ frame step: Gray-Scott advance → VDI generation
     → composite. Returns ``fn(u, v, eye) -> (color, depth, u, v)``
     (jittable; the flagship single-device hot path).
@@ -74,6 +75,15 @@ def grayscott_vdi_frame_step(width: int, height: int,
     temporal = vdi_cfg.adaptive and vdi_cfg.adaptive_mode == "temporal"
     if temporal and engine != "mxu":
         raise ValueError("adaptive_mode='temporal' needs engine='mxu'")
+    if render_dtype not in ("f32", "bf16"):
+        raise ValueError(f"render_dtype must be 'f32' or 'bf16', "
+                         f"got {render_dtype!r}")
+    # the 1024^3 memory plan: SIM state stays f32 (bf16 storage loses the
+    # ~1e-3 per-step reaction increments against values near 1.0 and the
+    # pattern stalls), but the RENDERED copy of the field can be bf16 —
+    # the march's permuted volume halves to ~2.1 GB at 1024^3 and the
+    # resampling einsum was casting to bf16 anyway (matmul_dtype)
+    rdt = jnp.bfloat16 if render_dtype == "bf16" else None
 
     def frame_step(u, v, eye, thr=None):
         if temporal and thr is None:
@@ -82,7 +92,8 @@ def grayscott_vdi_frame_step(width: int, height: int,
                 "frame_step(u, v, eye, thr), seeding thr with "
                 "frame_step.init_threshold(u, v, eye)")
         state = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
-        vol = Volume.centered(state.field, extent=2.0)
+        field = state.field if rdt is None else state.field.astype(rdt)
+        vol = Volume.centered(field, extent=2.0)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
         if temporal:
             vdi, _, _, thr = slicer.generate_vdi_mxu_temporal(

@@ -115,6 +115,21 @@ _EST_B = 32
 _PHASE2_GATED = False
 
 
+def strip_fpp(c: int, k: int, small_rows: int = _NSMALL,
+              count_plane: bool = True, per_slice_records: int = 7) -> int:
+    """Strip VMEM estimate in floats per pixel column — THE one budget
+    formula every fold kernel and its microbench twins share: in+out
+    blocks double-buffered (x2x2) over (6C stream + 1 threshold + 6K
+    state + small rows + optional count plane), plus the per-slice
+    record arrays (events or seg (slot,v) records) and slack for phase
+    temporaries. K floored at _EST_K for probe-geometry invariance.
+    Callers differing from the production fold pass their deltas
+    explicitly instead of hand-copying the formula."""
+    return (2 * 2 * (6 * c + 1 + 6 * max(k, _EST_K) + small_rows
+                     + (1 if count_plane else 0))
+            + per_slice_records * c + 64)
+
+
 def _pick_block_w(w: int, bytes_per_col: int) -> int:
     """Widest block (full row, else a multiple of 128 lanes) whose strip
     VMEM estimate stays under the budget. ``bytes_per_col`` is the
@@ -321,16 +336,9 @@ def fold_chunk(packed, rgba: jnp.ndarray, t0: jnp.ndarray, t1: jnp.ndarray,
     td = jnp.stack([t0, t1], axis=1)                       # [C, 2, H, W]
     with_count = count is not None
 
-    # strip VMEM estimate per pixel column: in+out blocks double-buffered
-    # (×2×2), plus the phase-2 event arrays (7 floats per slice) and slack
-    # for phase-1 SSA temporaries; K floored at _EST_K so the chosen block
-    # width matches the compile probe's geometry (see _EST_K)
-    k_est = max(kk, _EST_K)
     # the count plane is budgeted whether or not it rides along, for the
-    # same probe-geometry-invariance reason as k_est
-    floats_per_px = (2 * 2 * (6 * c + 1 + 6 * k_est + _NSMALL + 1)
-                     + 7 * c + 64)
-    wb = _pick_block_w(w, 4 * TILE_H * floats_per_px)
+    # same probe-geometry-invariance reason as strip_fpp's K floor
+    wb = _pick_block_w(w, 4 * TILE_H * strip_fpp(c, kk))
     grid = (h // TILE_H, pl.cdiv(w, wb))
     row = lambda *lead: pl.BlockSpec(lead + (TILE_H, wb),
                                      lambda j, i: (0,) * len(lead) + (j, i))

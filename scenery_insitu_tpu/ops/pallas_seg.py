@@ -136,9 +136,13 @@ def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
 
 
 def _floats_per_px(c: int, k: int) -> int:
-    """Strip VMEM estimate per pixel column: in+out blocks double-buffered
-    (x2x2) + the [C,5] scratch + slack for phase-A temporaries."""
-    return 2 * 2 * (6 * c + 1 + 6 * max(k, _EST_K) + _NSMALL) + 5 * c + 64
+    """Strip VMEM estimate per pixel column — the shared budget formula
+    with this kernel's deltas: 5 small rows, no count plane (cnt lives
+    in small), 5-float per-slice (slot, v) records."""
+    from scenery_insitu_tpu.ops.pallas_march import strip_fpp
+
+    return strip_fpp(c, k, small_rows=_NSMALL, count_plane=False,
+                     per_slice_records=5)
 
 
 def fold_chunk_packed(packed, rgba: jnp.ndarray, t0: jnp.ndarray,

@@ -412,13 +412,38 @@ def live_video_sink(streamer: VideoStreamer) -> Callable[[int, dict], None]:
 
 # -------------------------------------------------------------- video sinks
 
+def _open_video_writer(path: str, fps: float, size: Tuple[int, int]):
+    """Open a cv2 VideoWriter, preferring a real H264 encoder when the
+    cv2 build ships one (the reference streams H264 —
+    DistributedVolumeRenderer.kt:275-291 VideoEncoder → UDP:3337). Probes
+    avc1/H264 and falls back to mp4v. This image's cv2 carries no
+    libx264/openh264 and no ffmpeg/PyAV exists either (checked 2026-07-31),
+    so mp4v is the expected outcome here — the transport/movie role is
+    covered, H264 bitstream compatibility is an explicit environment gap
+    (see README "Known gaps"). A failed probe may print cv2/ffmpeg codec
+    errors to stderr once (native-layer prints, not exceptions); the
+    fallback proceeds regardless. Returns (writer, fourcc_used)."""
+    import cv2
+
+    for cc in ("avc1", "H264"):
+        try:
+            w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*cc), fps, size)
+        except cv2.error:
+            continue
+        if w.isOpened():
+            return w, cc
+        w.release()
+    return (cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps,
+                            size), "mp4v")
+
+
 def video_sink(path: str, fps: float = 30.0, gamma: float = 2.2
                ) -> Callable[[int, dict], None]:
     """Movie-writer sink for session image payloads (≅ the reference's
     VideoEncoder movie file, DistributedVolumeRenderer.kt:285). Lazily opens
-    the writer on the first frame (size unknown until then)."""
-    import cv2
-
+    the writer on the first frame (size unknown until then); the codec
+    actually used is exposed as ``sink.codec`` after that (H264 when the
+    cv2 build has an encoder, else mp4v — see `_open_video_writer`)."""
     state = {"writer": None}
 
     def sink(index: int, payload: dict) -> None:
@@ -429,10 +454,11 @@ def video_sink(path: str, fps: float = 30.0, gamma: float = 2.2
         frame = (np.moveaxis(rgb, 0, -1) * 255).astype(np.uint8)
         if state["writer"] is None:
             h, w = frame.shape[:2]
-            state["writer"] = cv2.VideoWriter(
-                path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+            state["writer"], sink.codec = _open_video_writer(
+                path, fps, (w, h))
         state["writer"].write(frame[:, :, ::-1])          # RGB -> BGR
 
+    sink.codec = None
     sink.release = lambda: (state["writer"].release()
                             if state["writer"] else None)
     return sink

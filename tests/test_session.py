@@ -91,6 +91,40 @@ def test_session_mxu_temporal(tmp_path):
     assert np.isfinite(np.asarray(thr.thr)).all()
 
 
+def test_session_prewarm_regimes():
+    """prewarm_regimes precompiles per-regime steps without touching the
+    loop's own state: camera, sim frame index and temporal thresholds all
+    restored; a later run() finds its regime already cached."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+
+    cfg = FrameworkConfig().with_overrides(
+        "slicer.engine=mxu", "slicer.scale=1.0",
+        "sim.grid=[16,16,16]", "sim.steps_per_frame=2",
+        "vdi.max_supersegments=6", "vdi.adaptive_mode=temporal",
+        "composite.max_output_supersegments=8", "mesh.num_devices=4")
+    s = InSituSession(cfg)
+    eye0 = np.asarray(s.camera.eye).copy()
+    start_regime = s._slicer.choose_axis(s.camera)
+    times = s.prewarm_regimes(regimes=[start_regime, (0, -1)])
+    assert set(times) == {start_regime, (0, -1)}
+    assert all(t >= 0 for t in times.values())
+    assert len(s._mxu_steps) == 2           # both regimes compiled
+    assert s._mxu_thr == {}                 # threshold state untouched
+    assert s.frame_index == 0               # no frames consumed
+    assert np.allclose(eye0, np.asarray(s.camera.eye))
+    # the first real frames run in start_regime: must reuse the
+    # prewarmed step, not compile a third entry
+    payload = s.run(2)
+    assert np.isfinite(payload["vdi_color"]).all()
+    assert len(s._mxu_steps) == 2           # nothing new compiled
+
+
+def test_session_prewarm_noop_modes():
+    """Engines/modes without per-regime jit return {} untouched."""
+    sess = InSituSession(_cfg(), mesh=make_mesh(2))   # gather engine on CPU
+    assert sess.prewarm_regimes() == {}
+
+
 def test_session_particle_mode():
     cfg = _cfg(**{"sim.kind": "lennard_jones", "sim.num_particles": 64,
                   "sim.particle_radius": 0.3})

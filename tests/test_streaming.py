@@ -191,6 +191,10 @@ def test_video_sink(tmp_path):
     sink.release()
     import os
     assert os.path.getsize(path) > 0
+    # the writer probes H264 first and records what it actually opened;
+    # in this image (no libx264/openh264/ffmpeg) that resolves to mp4v —
+    # the documented environment gap, not a silent downgrade
+    assert sink.codec in ("avc1", "H264", "mp4v")
 
 
 def test_live_video_stream_roundtrip():

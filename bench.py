@@ -132,6 +132,9 @@ def main():
     # models/pipelines.py render_dtype). Explicit env overrides.
     render_dtype = os.environ.get("SITPU_BENCH_RENDER_DTYPE",
                                   "bf16" if grid >= 1024 else "f32")
+    # in-plane occupancy tiles (0 = chunk skipping only; try 8 on sparse
+    # fields — see SliceMarchConfig.occupancy_vtiles)
+    vtiles = _env_int("SITPU_BENCH_VTILES", 0)
 
     from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
@@ -143,7 +146,8 @@ def main():
         ad_mode = "histogram"
 
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
-    march_cfg = SliceMarchConfig(fold=fold, chunk=chunk)
+    march_cfg = SliceMarchConfig(fold=fold, chunk=chunk,
+                             occupancy_vtiles=vtiles)
     frame_step = grayscott_vdi_frame_step(
         width, height, sim_steps=sim_steps, max_steps=steps,
         vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,
@@ -205,7 +209,8 @@ def main():
     if engine == "mxu":
         spec = slicer.make_spec(base, (grid, grid, grid), march_cfg)
         render_cfg = {"image": [spec.ni, spec.nj], "steps": grid,
-                      "fold": spec.fold, "render_dtype": render_dtype}
+                      "fold": spec.fold, "render_dtype": render_dtype,
+                      "vtiles": vtiles}
         res_tag = f"{spec.ni}x{spec.nj}"
         marches = (1 if temporal else
                    2 if ad_mode == "histogram" else ad_iters + 1)

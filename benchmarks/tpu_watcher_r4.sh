@@ -114,6 +114,12 @@ run_step() {  # run_step <n>
          python benchmarks/scaling_bench.py --grid 128 --frames 10 ;;
     15) run_json "$R/profile_frame_tpu_r4.json" 1200 \
          python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
+    # 16: in-plane occupancy tiles A/B at the flagship scale (VERDICT
+    # item 5) — early Gray-Scott frames are sparse, so vtiles=8 should
+    # show the (chunk x v-tile) skip against step 2's whole-slab run
+    16) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
+         SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
   esac
 }
 
@@ -134,10 +140,11 @@ step_out() {
     13) echo "$R/composite_tpu_r4.json" ;;
     14) echo "$R/scaling_tpu_r4.json" ;;
     15) echo "$R/profile_frame_tpu_r4.json" ;;
+    16) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
   esac
 }
 
-NSTEPS=15
+NSTEPS=16
 MAXFAIL=2
 for i in $(seq 1 500); do
   next=""

@@ -103,6 +103,12 @@ class SliceMarchConfig:
     # zero alpha (≅ the reference's OctreeCells occupancy acceleration,
     # VDIGenerator.comp:232-254 — here consumed, per-frame, by the march).
     skip_empty: bool = True
+    # In-plane occupancy tiles: 0 = chunk-granular skipping only; N > 0
+    # also splits each slice plane into N row tiles and skips the
+    # resampling matmuls + TF for output row blocks whose support is
+    # provably empty (see slicer.AxisSpec.vtiles). Adds N lax.cond
+    # branches per chunk — worth it on sparse fields, overhead on dense.
+    occupancy_vtiles: int = 0
     # Supersegment-fold schedule for the VDI marches:
     #   "xla"        sequential ss.push machine in a lax.scan (every slice
     #                round-trips the [K] state through HBM — the portable

@@ -89,6 +89,15 @@ class AxisSpec:
     # "pallas" (round-3 two-phase machine kernel) | "seg" (round-4
     # segmented-scan fold, ops/seg_fold.py) | "pallas_seg" (its VMEM twin)
     fold: str = "xla"
+    # in-plane occupancy granularity: 0 = whole-chunk skipping only;
+    # N > 0 additionally splits each slice plane into N row (v) tiles and
+    # skips the resampling matmuls + TF for OUTPUT row blocks whose
+    # bilinear support lies entirely in empty tiles (≅ the reference's
+    # per-(8x8 pixel, z-interval) OctreeCells skip,
+    # VDIGenerator.comp:232-254 — here at (chunk x v-tile) granularity,
+    # the axis the banded-matmul factorization can gate with static
+    # shapes). Conservative: gated blocks are provably zero-alpha.
+    vtiles: int = 0
 
     @property
     def u_axis(self) -> int:
@@ -165,10 +174,17 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     if fold not in ("xla", "pallas", "seg", "pallas_seg"):
         raise ValueError(f"unknown fold schedule {fold!r} (expected "
                          "'auto', 'xla', 'pallas', 'seg' or 'pallas_seg')")
+    # clamp the tile count to what the geometry supports: each band needs
+    # >= 2 volume rows (the apron + a zero-size reduction guard) and each
+    # output block >= 2 rows — a too-large request degrades to coarser
+    # tiles instead of an obscure trace-time error
+    vt = cfg.occupancy_vtiles
+    if vt:
+        vt = max(1, min(vt, dims_xyz[v_axis] // 2, nj // 2))
     return AxisSpec(axis=axis, sign=sign, ni=ni, nj=nj,
                     chunk=cfg.chunk, matmul_dtype=dtype,
                     s_floor=cfg.s_floor, skip_empty=cfg.skip_empty,
-                    fold=fold)
+                    fold=fold, vtiles=vt)
 
 
 class AxisCamera(NamedTuple):
@@ -341,13 +357,7 @@ def chunk_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
     inside each slice's [min, max], so a slab whose value range maps to
     zero alpha everywhere (``tf.max_alpha_in``) is provably invisible."""
     volp = permute_volume(vol, spec)
-    s_total = volp.shape[0]
-    c = spec.chunk
-    nchunks = -(-s_total // c)
-    if nchunks * c != s_total:
-        pad = nchunks * c - s_total
-        volp = jnp.concatenate(
-            [volp, jnp.zeros((pad,) + volp.shape[1:], volp.dtype)], axis=0)
+    volp, nchunks = _pad_to_chunks(volp, spec.chunk)
     if vol.data.ndim == 4:
         # pre-shaded RGBA: a slab is visible iff any stored alpha is
         alpha = volp[:, 3]
@@ -356,6 +366,81 @@ def chunk_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
     lo = jnp.clip(jnp.min(slabs, axis=1), 0.0, 1.0)
     hi = jnp.clip(jnp.max(slabs, axis=1), 0.0, 1.0)
     return tf.max_alpha_in(lo, hi) > alpha_eps
+
+
+def _pad_to_chunks(volp: jnp.ndarray, c: int) -> Tuple[jnp.ndarray, int]:
+    """Zero-pad the march-layout volume along slices to a chunk multiple;
+    returns (padded, nchunks). One implementation for the march and every
+    occupancy pass, so slab boundaries can never disagree."""
+    s_total = volp.shape[0]
+    nchunks = -(-s_total // c)
+    if nchunks * c != s_total:
+        volp = jnp.concatenate(
+            [volp, jnp.zeros((nchunks * c - s_total,) + volp.shape[1:],
+                             volp.dtype)], axis=0)
+    return volp, nchunks
+
+
+def chunk_occupancy_vtiles(vol: Volume, tf: TransferFunction,
+                           spec: AxisSpec, alpha_eps: float = 1e-5
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(bool[nchunks], bool[nchunks, vtiles]): chunk- and
+    (chunk x v-row-band)-granular occupancy in ONE pass over the volume —
+    the in-plane refinement of `chunk_occupancy` (≅ OctreeCells' per-cell
+    skip, VDIGenerator.comp:232-254), with the chunk level derived from
+    the same per-band value ranges (identical to the separate whole-slab
+    reduction, at no extra volume traffic).
+
+    Each band's range carries a ONE-ROW APRON into its neighbors: an
+    output row's bilinear support is two adjacent volume rows which may
+    straddle a band boundary, and the interpolated value lies between
+    values in the gap of the two bands' ranges — with a band-pass (non-
+    monotone) transfer function that gap can hit an alpha peak neither
+    apron-less band sees. The apron makes every adjacent-row pair fully
+    contained in at least one band, restoring the conservative argument.
+    Tiles split the VOLUME's v axis; the last band absorbs the remainder.
+    """
+    volp = permute_volume(vol, spec)                       # [S, Nv, Nu]
+    pre_shaded = vol.data.ndim == 4
+    if pre_shaded:
+        volp = volp[:, 3]                                  # alpha plane
+    volp, nchunks = _pad_to_chunks(volp, spec.chunk)
+    nv = volp.shape[1]
+    nt = spec.vtiles
+    tv = nv // nt
+    occ, los, his = [], [], []
+    for t in range(nt):
+        lo_r = max(t * tv - 1, 0)                          # apron row
+        hi_r = nv if t == nt - 1 else min((t + 1) * tv + 1, nv)
+        band = volp[:, lo_r:hi_r].reshape(nchunks, -1)
+        if pre_shaded:
+            occ.append(band.max(axis=1) > alpha_eps)
+        else:
+            lo = jnp.clip(jnp.min(band, axis=1), 0.0, 1.0)
+            hi = jnp.clip(jnp.max(band, axis=1), 0.0, 1.0)
+            occ.append(tf.max_alpha_in(lo, hi) > alpha_eps)
+            los.append(lo)
+            his.append(hi)
+    tiles = jnp.stack(occ, axis=1)                         # [nchunks, nt]
+    if pre_shaded:
+        chunks = jnp.any(tiles, axis=1)
+    else:
+        # whole-slab range = union of the band ranges (aprons only widen
+        # within the slab), so this equals chunk_occupancy exactly
+        chunks = tf.max_alpha_in(jnp.min(jnp.stack(los), axis=0),
+                                 jnp.max(jnp.stack(his), axis=0)) > alpha_eps
+    return chunks, tiles
+
+
+def occupancy_for(vol: Volume, tf: TransferFunction, spec: AxisSpec):
+    """The occupancy structure `slice_march` consumes for this spec:
+    None (skipping off), bool[nchunks], or (chunk, tile) tuple when
+    ``spec.vtiles > 0``."""
+    if not spec.skip_empty:
+        return None
+    if spec.vtiles > 0:
+        return chunk_occupancy_vtiles(vol, tf, spec)
+    return chunk_occupancy(vol, tf, spec)
 
 
 def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
@@ -379,19 +464,21 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     resampling matmuls and fold for provably-empty chunks; the skipped
     branch still feeds ONE all-empty sample so stream-gap semantics
     (supersegment closing on empty) are identical to the full march.
+    A TUPLE ``(chunk_occ, tile_occ)`` (see `chunk_occupancy_vtiles` and
+    `occupancy_for`) additionally gates output row BLOCKS inside live
+    chunks on the in-plane tile occupancy — the reference's OctreeCells
+    granularity along the axis the matmul factorization can skip.
     ``early_stop(carry) -> bool[]`` additionally skips every chunk after
     the predicate turns true (alpha-saturation early-out, ≅ the
     reference's early exit in AccumulatePlainImage.comp:8-13).
     """
     pre_shaded = vol.data.ndim == 4
-    volp = permute_volume(vol, spec)
-    s_total = volp.shape[0]
+    occ_tiles = None
+    if isinstance(occupancy, tuple):
+        occupancy, occ_tiles = occupancy
+    s_total = permute_volume(vol, spec).shape[0]
     c = spec.chunk
-    nchunks = -(-s_total // c)
-    if nchunks * c != s_total:
-        pad = nchunks * c - s_total
-        volp = jnp.concatenate(
-            [volp, jnp.zeros((pad,) + volp.shape[1:], volp.dtype)], axis=0)
+    volp, nchunks = _pad_to_chunks(permute_volume(vol, spec), c)
 
     ou, su, nu, ov, sv, nv = _axis_params(vol, spec)
     eu, ev, ew = axcam.eye_u, axcam.eye_v, axcam.eye_w
@@ -426,35 +513,78 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 
         inside = (wv.sum(-1) > 0.0)[:, :, None] & (wu.sum(-1) > 0.0)[:, None, :]
         keep = inside & live[:, None, None]
-        if pre_shaded:
-            # stored premultiplied RGBA; alpha encoded per nominal step
-            val = jnp.einsum("cjy,cdyx,cix->cdji",
-                             wv.astype(mm), slices.astype(mm),
-                             wu.astype(mm),
-                             preferred_element_type=jnp.float32)
-            a_res = jnp.clip(val[:, 3], 0.0, 1.0 - 1e-6)
-            a_res = jnp.where(keep, a_res, 0.0)
-            alpha = adjust_opacity(a_res, ratio[None])
-            # premultiplied rgb scales with its alpha re-correction
-            scale = alpha / jnp.maximum(a_res, 1e-6)
-            rgba = jnp.concatenate(
-                [jnp.clip(val[:, :3], 0.0, 1.0) * scale[:, None],
-                 alpha[:, None]], axis=1)
-        else:
+
+        def rows_rgba(wv_r, keep_r, ratio_r):
+            """Resample + shade one block of output rows ([C,B,*])."""
+            if pre_shaded:
+                # stored premultiplied RGBA; alpha encoded per nominal step
+                val = jnp.einsum("cjy,cdyx,cix->cdji",
+                                 wv_r.astype(mm), slices.astype(mm),
+                                 wu.astype(mm),
+                                 preferred_element_type=jnp.float32)
+                a_res = jnp.clip(val[:, 3], 0.0, 1.0 - 1e-6)
+                a_res = jnp.where(keep_r, a_res, 0.0)
+                alpha = adjust_opacity(a_res, ratio_r[None])
+                # premultiplied rgb scales with its alpha re-correction
+                scale = alpha / jnp.maximum(a_res, 1e-6)
+                return jnp.concatenate(
+                    [jnp.clip(val[:, :3], 0.0, 1.0) * scale[:, None],
+                     alpha[:, None]], axis=1)
             val = jnp.einsum("cjy,cyx,cix->cji",
-                             wv.astype(mm), slices.astype(mm),
+                             wv_r.astype(mm), slices.astype(mm),
                              wu.astype(mm),
                              preferred_element_type=jnp.float32)
             val = jnp.clip(val, 0.0, 1.0)
 
-            rgb, alpha = tf(val)                   # [C,Nj,Ni,3], [C,Nj,Ni]
+            rgb, alpha = tf(val)                   # [C,B,Ni,3], [C,B,Ni]
             # outside-volume samples must be fully transparent even when
             # the transfer function maps value 0 to nonzero alpha
-            alpha = jnp.where(keep, alpha, 0.0)
-            alpha = adjust_opacity(alpha, ratio[None])
-            rgba = jnp.concatenate(
+            alpha = jnp.where(keep_r, alpha, 0.0)
+            alpha = adjust_opacity(alpha, ratio_r[None])
+            return jnp.concatenate(
                 [jnp.moveaxis(rgb, -1, 1) * alpha[:, None],
                  alpha[:, None]], axis=1)
+
+        if occ_tiles is None:
+            rgba = rows_rgba(wv, keep, ratio)
+        else:
+            # in-plane skipping: gate each OUTPUT row block on whether
+            # its bilinear support intersects any occupied (chunk,
+            # v-tile). The support of output rows is derived from the
+            # block's sampled voxel coordinates over LIVE slices; a block
+            # whose whole support lies in empty tiles is provably
+            # zero-alpha (value ranges are preserved by interpolation).
+            nt = occ_tiles.shape[1]
+            tv = nv // nt
+            occ_row = occ_tiles[ci]                        # bool[nt]
+            tile_ids = jnp.arange(nt)
+            xv = (pos_v - ov) / sv - 0.5                   # [C, Nj] voxels
+            nb = nt
+            bsz = spec.nj // nb
+            blocks = []
+            for b in range(nb):
+                b0 = b * bsz
+                b1 = spec.nj if b == nb - 1 else (b0 + bsz)
+                xb = xv[:, b0:b1]
+                big = jnp.float32(2 * nv)
+                xlo = jnp.min(jnp.where(live[:, None], xb, big))
+                xhi = jnp.max(jnp.where(live[:, None], xb, -big))
+                r_lo = jnp.clip(jnp.floor(xlo), 0, nv - 1)
+                r_hi = jnp.clip(jnp.floor(xhi) + 1.0, 0, nv - 1)
+                t_lo = jnp.minimum(r_lo // tv, nt - 1).astype(jnp.int32)
+                t_hi = jnp.minimum(r_hi // tv, nt - 1).astype(jnp.int32)
+                hit = jnp.any(occ_row & (tile_ids >= t_lo)
+                              & (tile_ids <= t_hi)) & (xlo <= xhi)
+                wv_b = wv[:, b0:b1]
+                keep_b = keep[:, b0:b1]
+                ratio_b = ratio[b0:b1]
+                blocks.append(jax.lax.cond(
+                    hit,
+                    lambda wv_b=wv_b, keep_b=keep_b, ratio_b=ratio_b:
+                        rows_rgba(wv_b, keep_b, ratio_b),
+                    lambda nb_=b1 - b0: jnp.zeros(
+                        (c, 4, nb_, spec.ni), jnp.float32)))
+            rgba = jnp.concatenate(blocks, axis=2)
 
         t0 = sk[:, None, None] * length[None]
         t1 = (sk + ds)[:, None, None] * length[None]
@@ -548,7 +678,7 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 
     acc0 = jnp.zeros((4, spec.nj, spec.ni), jnp.float32)
     t0 = jnp.full((spec.nj, spec.ni), jnp.inf, jnp.float32)
-    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
+    occ = occupancy_for(vol, tf, spec)
     acc, first_t = slice_march(vol, tf, axcam, spec, consume, (acc0, t0),
                                u_bounds, v_bounds, step_scale, occupancy=occ)
     return RaycastOutput(acc, first_t)
@@ -662,7 +792,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
 
     # one occupancy pass shared by every counting + writing march
-    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
+    occ = occupancy_for(vol, tf, spec)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
         occupancy=occ)
@@ -775,7 +905,7 @@ def initial_threshold(vol: Volume, tf: TransferFunction, cam: Camera,
     in band for one-march frames)."""
     cfg = cfg or VDIConfig()
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
-    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
+    occ = occupancy_for(vol, tf, spec)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
         occupancy=occ)
@@ -814,7 +944,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
     nj, ni = spec.nj, spec.ni
     thr = threshold.thr
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
-    occ = chunk_occupancy(vol, tf, spec) if spec.skip_empty else None
+    occ = occupancy_for(vol, tf, spec)
 
     if spec.fold == "pallas":
         # fused write+count: ONE kernel per chunk, the count rides the

@@ -383,3 +383,86 @@ def test_generate_vdi_mxu_rejects_temporal_mode(vol, tf):
     with pytest.raises(ValueError, match="temporal"):
         slicer.generate_vdi_mxu(
             vol, tf, cam, spec, VDIConfig(adaptive_mode="temporal"))
+
+
+def test_vtile_occupancy_gating_is_exact(tf):
+    """In-plane occupancy tiles (spec.vtiles > 0) must change NOTHING in
+    the output — gated row blocks are provably zero-alpha, so tiled and
+    untiled renders and VDIs must match to the bit. Sparse corner blob:
+    most (chunk, v-tile) cells empty, so the gate genuinely fires."""
+    data = np.zeros((48, 48, 48), np.float32)
+    data[4:16, 6:18, 8:20] = 0.8            # one blob near a corner
+    svol = Volume.centered(jnp.asarray(data), extent=2.0)
+    cam = Camera.create((0.3, 0.4, 2.8), fov_y_deg=45.0, near=0.3,
+                        far=10.0)
+    base = SliceMarchConfig(matmul_dtype="f32", scale=1.25)
+    tiled = SliceMarchConfig(matmul_dtype="f32", scale=1.25,
+                             occupancy_vtiles=6)
+    spec0 = slicer.make_spec(cam, svol.data.shape, base)
+    spec1 = slicer.make_spec(cam, svol.data.shape, tiled)
+    assert spec1.vtiles == 6
+
+    # the occupancy structure really is tile-granular and really sparse
+    occ = slicer.occupancy_for(svol, tf, spec1)
+    assert isinstance(occ, tuple)
+    tile_frac = float(np.asarray(occ[1]).mean())
+    assert tile_frac < 0.5, f"blob scene not sparse? {tile_frac}"
+
+    img0 = slicer.raycast_mxu(svol, tf, cam, 64, 48, spec0)
+    img1 = slicer.raycast_mxu(svol, tf, cam, 64, 48, spec1)
+    np.testing.assert_array_equal(np.asarray(img1.image),
+                                  np.asarray(img0.image))
+    np.testing.assert_array_equal(np.asarray(img1.depth),
+                                  np.asarray(img0.depth))
+
+    cfg = VDIConfig(max_supersegments=6, adaptive_mode="histogram",
+                    histogram_bins=8)
+    vdi0, _, _ = slicer.generate_vdi_mxu(svol, tf, cam, spec0, cfg)
+    vdi1, _, _ = slicer.generate_vdi_mxu(svol, tf, cam, spec1, cfg)
+    np.testing.assert_array_equal(np.asarray(vdi1.color),
+                                  np.asarray(vdi0.color))
+    np.testing.assert_array_equal(np.asarray(vdi1.depth),
+                                  np.asarray(vdi0.depth))
+
+
+def test_vtile_apron_catches_bandpass_tf():
+    """The adversarial case for banded occupancy: two value plateaus
+    meeting exactly AT a tile boundary, and a band-pass TF whose alpha
+    peak lies strictly between the plateau values. Only interpolated
+    rows near the boundary produce visible alpha; apron-less bands would
+    both claim 'empty' and the gated march would drop the interface."""
+    from scenery_insitu_tpu.core.transfer import TransferFunction
+
+    n = 48
+    data = np.zeros((n, n, n), np.float32)
+    data[:, n // 2:, :] = 1.0               # plateau split along v (y)
+    svol = Volume.centered(jnp.asarray(data), extent=2.0)
+    bp_tf = TransferFunction.from_polylines(
+        [(0.0, 0.0), (0.5, 0.9), (1.0, 0.0)],      # peak between plateaus
+        np.array([0.0, 1.0]),
+        np.array([[1.0, 0.5, 0.1], [1.0, 0.5, 0.1]], np.float32))
+    cam = Camera.create((0.1, 0.2, 2.9), fov_y_deg=45.0, near=0.3,
+                        far=10.0)
+    base = SliceMarchConfig(matmul_dtype="f32", scale=1.25)
+    tiled = SliceMarchConfig(matmul_dtype="f32", scale=1.25,
+                             occupancy_vtiles=6)   # boundary ON a tile edge
+    spec0 = slicer.make_spec(cam, svol.data.shape, base)
+    spec1 = slicer.make_spec(cam, svol.data.shape, tiled)
+    img0 = slicer.raycast_mxu(svol, bp_tf, cam, 64, 48, spec0)
+    img1 = slicer.raycast_mxu(svol, bp_tf, cam, 64, 48, spec1)
+    # the interface IS visible (nonzero alpha) and the tiled render
+    # reproduces it exactly
+    assert float(np.asarray(img0.image)[3].max()) > 0.2
+    np.testing.assert_array_equal(np.asarray(img1.image),
+                                  np.asarray(img0.image))
+
+
+def test_vtile_clamp_on_small_volumes():
+    """An oversized occupancy_vtiles request degrades to coarser tiles
+    instead of zero-width bands blowing up at trace time."""
+    cam = Camera.create((0.0, 0.1, 2.8), fov_y_deg=45.0, near=0.3,
+                        far=10.0)
+    spec = slicer.make_spec(cam, (16, 16, 16),
+                            SliceMarchConfig(matmul_dtype="f32", scale=1.0,
+                                             occupancy_vtiles=64))
+    assert 0 < spec.vtiles <= 8

@@ -27,6 +27,13 @@ for each schedule:
   count        pm.count_multi_chunk with 1 candidate — the O(1)-state
                floor: stream generation + predicate, no K-slot writes
   none         stream generation only (the harness overhead floor)
+  fused        shade-in-kernel seg fold (ops/pallas_seg.fused_fold_chunk,
+               fold="pallas_fused"): consumes the 1-channel raw VALUE
+               stream, TF + opacity + depths computed in-kernel
+  tf_pallas_seg / tf_xla_seg
+               same value stream shaded in XLA feeding pallas_seg / seg —
+               the controlled baselines for 'fused' (this family is
+               parity-checked against tf_xla_seg, not the rgba family)
 
 Usage: python benchmarks/fold_microbench.py [--grid 256] [--k 16]
        [--chunk 16] [--iters 5] [--variants xla,pallas,...]
@@ -45,6 +52,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from scenery_insitu_tpu.ops import pallas_march as pm
 from scenery_insitu_tpu.ops import pallas_seg as psg
@@ -77,6 +85,53 @@ def stream_chunk(ci: jnp.ndarray, c: int, h: int, w: int):
     t0 = (s[:, None, None] + 0.0) * 0.01 + jj[None] * 0.0 + ii[None] * 0.0
     t0 = jnp.broadcast_to(t0, (c, h, w))
     t1 = t0 + 0.01
+    return rgba, t0, t1
+
+
+def stream_val_chunk(ci: jnp.ndarray, c: int, h: int, w: int):
+    """Deterministic RAW VALUE chunk [C,H,W] + per-slice depth ratios
+    [C] — the fused-kernel feed (shading happens downstream, either
+    in-kernel or in XLA, so 'fused' vs 'tf_*' variants consume the SAME
+    stream and are directly comparable; NOT comparable to the rgba-stream
+    variants above, whose colors no 1-D transfer function can produce)."""
+    s = ci * c + jnp.arange(c, dtype=jnp.float32)
+    jj = jnp.arange(h, dtype=jnp.float32)[:, None]
+    ii = jnp.arange(w, dtype=jnp.float32)[None, :]
+    c0 = 60.0 + 0.15 * jj + 0.05 * ii
+    c1 = c0 + 90.0
+    d0 = jnp.abs(s[:, None, None] - c0[None])
+    d1 = jnp.abs(s[:, None, None] - c1[None])
+    val = jnp.maximum(jnp.maximum(0.0, 0.9 - d0 * 0.03),
+                      jnp.maximum(0.0, 0.7 - d1 * 0.025))
+    # a dead-sample margin exercises the sentinel path
+    val = jnp.where((jj < 2)[None] | (ii < 2)[None], -1.0, val)
+    sk = 1.0 + s * 0.01
+    return val, sk
+
+
+def _fused_tf():
+    from scenery_insitu_tpu.core.transfer import TransferFunction
+
+    return TransferFunction.from_polylines(
+        [(0.0, 0.0), (0.2, 0.1), (0.8, 0.8)],
+        np.asarray([0.0, 0.5, 1.0]),
+        np.asarray([[0.1, 0.2, 0.9], [0.9, 0.4, 0.1], [1.0, 0.9, 0.2]],
+                   np.float32))
+
+
+def _shade_xla(val, sk, tf, length, ratio, ds):
+    """XLA twin of the fused kernel's in-kernel shading — produces the
+    rgba/t0/t1 streams slice_march's non-raw path would feed the fold."""
+    from scenery_insitu_tpu.ops.sampling import adjust_opacity
+
+    x = jnp.clip(val, 0.0, 1.0)
+    rgb, a = tf(x)
+    a = jnp.where(val < -0.5, 0.0, a)
+    a = adjust_opacity(a, ratio[None])
+    rgba = jnp.concatenate([jnp.moveaxis(rgb, -1, 1) * a[:, None],
+                            a[:, None]], axis=1)
+    t0 = sk[:, None, None] * length[None]
+    t1 = (sk + ds)[:, None, None] * length[None]
     return rgba, t0, t1
 
 
@@ -366,6 +421,47 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
             packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
                                      jnp.arange(nchunks))
             return sfold.seg_finalize(psg.unpack_seg_state(packed))
+    elif variant in ("fused", "tf_pallas_seg", "tf_xla_seg"):
+        # VAL-STREAM family: same raw value stream, shading either
+        # in-kernel (fused) or in XLA feeding a seg fold — the direct
+        # measure of what fusing the TF + depth streams into the kernel
+        # buys. Parity-checked against each other, not the rgba family.
+        tf = _fused_tf()
+        length = jnp.ones((h, w), jnp.float32)
+        ratio = jnp.ones((h, w), jnp.float32)
+        ds = jnp.float32(0.01)
+        if variant == "fused":
+            def run():
+                def body(packed, ci):
+                    val, sk = stream_val_chunk(ci, c, h, w)
+                    return psg.fused_fold_chunk(
+                        packed, val, length, ratio, sk, sk + ds, thr,
+                        max_k=k, tf=tf), None
+                packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
+                                         jnp.arange(nchunks))
+                return sfold.seg_finalize(psg.unpack_seg_state(packed))
+        elif variant == "tf_pallas_seg":
+            def run():
+                def body(packed, ci):
+                    val, sk = stream_val_chunk(ci, c, h, w)
+                    rgba, t0, t1 = _shade_xla(val, sk, tf, length, ratio,
+                                              ds)
+                    return psg.fold_chunk_packed(packed, rgba, t0, t1,
+                                                 thr, max_k=k), None
+                packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
+                                         jnp.arange(nchunks))
+                return sfold.seg_finalize(psg.unpack_seg_state(packed))
+        else:
+            def run():
+                def body(st, ci):
+                    val, sk = stream_val_chunk(ci, c, h, w)
+                    rgba, t0, t1 = _shade_xla(val, sk, tf, length, ratio,
+                                              ds)
+                    return sfold.seg_fold_chunk(st, rgba, t0, t1, thr,
+                                                max_k=k), None
+                st, _ = jax.lax.scan(body, sfold.init_seg_state(k, h, w),
+                                     jnp.arange(nchunks))
+                return sfold.seg_finalize(st)
     elif variant.startswith("pallas"):
         # pallas_tN: strip height N; pallas_wN: block width N (the
         # production kernel picks width by VMEM budget — see
@@ -497,9 +593,15 @@ def main():
           file=sys.stderr, flush=True)
 
     timed_variants = [v.strip() for v in args.variants.split(",")]
+    _VAL_FAMILY = ("fused", "tf_pallas_seg", "tf_xla_seg")
     if args.check:
-        import numpy as np
         ref = jax.jit(build("xla", s_total, args.chunk, args.k, h, w))()
+        # the val-stream family consumes a different (raw value) stream:
+        # its reference is the XLA-shaded seg fold on that same stream
+        ref_val = None
+        if any(v in _VAL_FAMILY for v in timed_variants):
+            ref_val = jax.jit(build("tf_xla_seg", s_total, args.chunk,
+                                    args.k, h, w))()
         # every requested fold-producing variant (anything but the xla
         # reference and the non-folding floors) must match the xla fold —
         # a geometry/schedule variant with wrong numerics must not get
@@ -510,11 +612,12 @@ def main():
         # must never lose the whole sweep to one bad variant).
         passed, failed = [], []
         for v in [x for x in timed_variants
-                  if x not in ("xla", "count", "none")]:
+                  if x not in ("xla", "count", "none", "tf_xla_seg")]:
             try:
                 got = jax.jit(build(v, s_total, args.chunk, args.k, h, w))()
-                for a, b, name in [(ref[0], got[0], "color"),
-                                   (ref[1], got[1], "depth")]:
+                base = ref_val if v in _VAL_FAMILY else ref
+                for a, b, name in [(base[0], got[0], "color"),
+                                   (base[1], got[1], "depth")]:
                     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                                rtol=1e-5, atol=1e-5,
                                                err_msg=f"{v} {name}")

@@ -57,7 +57,7 @@ run_step() {  # run_step <n>
     # the flagship 512 scale, parity-checked (per-variant guarded).
     1) run_jsonl "$R/fold_microbench_512_seg_r4.jsonl" 2400 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
-         --variants none,count,xla,seg,pallas_seg,pallas ;;
+         --variants none,count,xla,seg,pallas_seg,pallas,fused,tf_pallas_seg,tf_xla_seg ;;
     # 2: flagship 512^3 with the new default fold (auto -> pallas_seg)
     2) run_json "$R/bench_tpu_r4_512.json" 1000 env \
          SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
@@ -70,7 +70,7 @@ run_step() {  # run_step <n>
     # round-3 numbers (xla 15.4 / two-phase pallas 16.0 ms per march)
     4) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
-         --variants none,count,xla,seg,pallas_seg,pallas ;;
+         --variants none,count,xla,seg,pallas_seg,pallas,fused,tf_pallas_seg,tf_xla_seg ;;
     # 5: march-stage profile at the flagship scale (VERDICT item 2: where
     # do the ~34 counting-march ms go — einsums, TF, opacity, fold?)
     5) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
@@ -120,6 +120,11 @@ run_step() {  # run_step <n>
     16) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
          SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 17: flagship on the fused shade+fold kernel — the rgba and depth
+    # streams never exist in HBM (the reference's one-kernel generation)
+    17) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
+         SITPU_BENCH_FOLD=pallas_fused SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
   esac
 }
 
@@ -141,10 +146,11 @@ step_out() {
     14) echo "$R/scaling_tpu_r4.json" ;;
     15) echo "$R/profile_frame_tpu_r4.json" ;;
     16) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
+    17) echo "$R/bench_tpu_r4_512_fused.json" ;;
   esac
 }
 
-NSTEPS=16
+NSTEPS=17
 MAXFAIL=2
 for i in $(seq 1 500); do
   next=""

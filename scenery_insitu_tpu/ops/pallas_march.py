@@ -116,16 +116,19 @@ _PHASE2_GATED = False
 
 
 def strip_fpp(c: int, k: int, small_rows: int = _NSMALL,
-              count_plane: bool = True, per_slice_records: int = 7) -> int:
+              count_plane: bool = True, per_slice_records: int = 7,
+              stream_per_slice: int = 6, extra_planes: int = 0) -> int:
     """Strip VMEM estimate in floats per pixel column — THE one budget
     formula every fold kernel and its microbench twins share: in+out
-    blocks double-buffered (x2x2) over (6C stream + 1 threshold + 6K
-    state + small rows + optional count plane), plus the per-slice
-    record arrays (events or seg (slot,v) records) and slack for phase
-    temporaries. K floored at _EST_K for probe-geometry invariance.
-    Callers differing from the production fold pass their deltas
-    explicitly instead of hand-copying the formula."""
-    return (2 * 2 * (6 * c + 1 + 6 * max(k, _EST_K) + small_rows
+    blocks double-buffered (x2x2) over (stream_per_slice*C stream +
+    1 threshold + extra per-pixel planes + 6K state + small rows +
+    optional count plane), plus the per-slice record arrays (events or
+    seg (slot,v) records) and slack for phase temporaries. K floored at
+    _EST_K for probe-geometry invariance. Callers differing from the
+    production fold pass their deltas explicitly instead of hand-copying
+    the formula."""
+    return (2 * 2 * (stream_per_slice * c + 1 + extra_planes
+                     + 6 * max(k, _EST_K) + small_rows
                      + (1 if count_plane else 0))
             + per_slice_records * c + 64)
 

@@ -171,9 +171,10 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
                     and pm.count_compile_ok(32, cfg.chunk, ni) else "seg")
         else:
             fold = "xla"
-    if fold not in ("xla", "pallas", "seg", "pallas_seg"):
-        raise ValueError(f"unknown fold schedule {fold!r} (expected "
-                         "'auto', 'xla', 'pallas', 'seg' or 'pallas_seg')")
+    if fold not in ("xla", "pallas", "seg", "pallas_seg", "pallas_fused"):
+        raise ValueError(f"unknown fold schedule {fold!r} (expected 'auto', "
+                         "'xla', 'pallas', 'seg', 'pallas_seg' or "
+                         "'pallas_fused')")
     # clamp the tile count to what the geometry supports: each band needs
     # >= 2 volume rows (the apron + a zero-size reduction guard) and each
     # output block >= 2 rows — a too-large request degrades to coarser
@@ -406,7 +407,12 @@ def chunk_occupancy_vtiles(vol: Volume, tf: TransferFunction,
         volp = volp[:, 3]                                  # alpha plane
     volp, nchunks = _pad_to_chunks(volp, spec.chunk)
     nv = volp.shape[1]
-    nt = spec.vtiles
+    # re-clamp against THIS volume's v extent: make_spec clamped against
+    # the global shape, but distributed ranks march slabs whose sharded
+    # axis can be far smaller — nv // nt must stay >= 2 (tv = 0 would
+    # poison the gate's tile arithmetic). Consumers read the tile count
+    # from the array's shape, so the clamp propagates automatically.
+    nt = max(1, min(spec.vtiles, nv // 2))
     tv = nv // nt
     occ, los, his = [], [], []
     for t in range(nt):
@@ -447,7 +453,7 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 spec: AxisSpec, consume: Callable, carry0,
                 u_bounds=None, v_bounds=None, step_scale: float = 1.0,
                 occupancy: Optional[jnp.ndarray] = None,
-                early_stop: Optional[Callable] = None):
+                early_stop: Optional[Callable] = None, raw: bool = False):
     """The chunked slice march. Calls ``consume(carry, rgba [C,4,Nj,Ni],
     t0 [C,Nj,Ni], t1 [C,Nj,Ni]) -> carry`` for each chunk of slices, front
     to back, and returns the final carry.
@@ -471,14 +477,26 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     ``early_stop(carry) -> bool[]`` additionally skips every chunk after
     the predicate turns true (alpha-saturation early-out, ≅ the
     reference's early exit in AccumulatePlainImage.comp:8-13).
+
+    ``raw=True`` changes the consume contract to ``consume(carry,
+    val [C,Nj,Ni], sk [C]) -> carry``: the RESAMPLED VALUE plane with a
+    ``-1`` sentinel for dead samples (outside volume/bounds, dropped
+    slices) and the per-slice eye-depth ratios — no transfer function,
+    no opacity correction, no t0/t1 streams. This is the fused-kernel
+    feed (ops/pallas_seg.fused_fold_chunk shades in-kernel); scalar
+    volumes only.
     """
     pre_shaded = vol.data.ndim == 4
+    if raw and pre_shaded:
+        raise ValueError("raw slice_march feeds a transfer-function "
+                         "kernel; pre-shaded volumes have no TF")
     occ_tiles = None
     if isinstance(occupancy, tuple):
         occupancy, occ_tiles = occupancy
-    s_total = permute_volume(vol, spec).shape[0]
+    volp0 = permute_volume(vol, spec)
+    s_total = volp0.shape[0]
     c = spec.chunk
-    volp, nchunks = _pad_to_chunks(permute_volume(vol, spec), c)
+    volp, nchunks = _pad_to_chunks(volp0, c)
 
     ou, su, nu, ov, sv, nv = _axis_params(vol, spec)
     eu, ev, ew = axcam.eye_u, axcam.eye_v, axcam.eye_w
@@ -514,6 +532,17 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
         inside = (wv.sum(-1) > 0.0)[:, :, None] & (wu.sum(-1) > 0.0)[:, None, :]
         keep = inside & live[:, None, None]
 
+        def rows_val(wv_r, keep_r):
+            """Raw-mode block: resampled values, -1 where dead."""
+            val = jnp.einsum("cjy,cyx,cix->cji",
+                             wv_r.astype(mm), slices.astype(mm),
+                             wu.astype(mm),
+                             preferred_element_type=jnp.float32)
+            # clip BEFORE the sentinel so a genuine value <= -0.5 (un-
+            # normalized field) can't be conflated with a dead sample;
+            # exact — every shading path clips to [0,1] anyway
+            return jnp.where(keep_r, jnp.clip(val, 0.0, 1.0), -1.0)
+
         def rows_rgba(wv_r, keep_r, ratio_r):
             """Resample + shade one block of output rows ([C,B,*])."""
             if pre_shaded:
@@ -545,8 +574,10 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 [jnp.moveaxis(rgb, -1, 1) * alpha[:, None],
                  alpha[:, None]], axis=1)
 
+        rows_fn = ((lambda wv_r, keep_r, ratio_r: rows_val(wv_r, keep_r))
+                   if raw else rows_rgba)
         if occ_tiles is None:
-            rgba = rows_rgba(wv, keep, ratio)
+            rgba = rows_fn(wv, keep, ratio)
         else:
             # in-plane skipping: gate each OUTPUT row block on whether
             # its bilinear support intersects any occupied (chunk,
@@ -578,14 +609,20 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 wv_b = wv[:, b0:b1]
                 keep_b = keep[:, b0:b1]
                 ratio_b = ratio[b0:b1]
+                fill = -1.0 if raw else 0.0
+                shp = ((c, b1 - b0, spec.ni) if raw
+                       else (c, 4, b1 - b0, spec.ni))
+                cat_ax = 1 if raw else 2
                 blocks.append(jax.lax.cond(
                     hit,
                     lambda wv_b=wv_b, keep_b=keep_b, ratio_b=ratio_b:
-                        rows_rgba(wv_b, keep_b, ratio_b),
-                    lambda nb_=b1 - b0: jnp.zeros(
-                        (c, 4, nb_, spec.ni), jnp.float32)))
-            rgba = jnp.concatenate(blocks, axis=2)
+                        rows_fn(wv_b, keep_b, ratio_b),
+                    lambda shp=shp, fill=fill: jnp.full(shp, fill,
+                                                        jnp.float32)))
+            rgba = jnp.concatenate(blocks, axis=cat_ax)
 
+        if raw:
+            return consume(carry, rgba, sk)
         t0 = sk[:, None, None] * length[None]
         t1 = (sk + ds)[:, None, None] * length[None]
         return consume(carry, rgba, t0, t1)
@@ -593,9 +630,13 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     def skip(carry, ci):
         # one explicit empty sample: closes any open supersegment exactly
         # like the stream of empties the full march would have produced
-        empty = jnp.zeros((1, 4, spec.nj, spec.ni), jnp.float32)
         s0 = jnp.float32(spec.sign) * (local_w0 + ci * c * axcam.dwm - ew) \
             / axcam.zp
+        if raw:
+            return consume(carry,
+                           jnp.full((1, spec.nj, spec.ni), -1.0,
+                                    jnp.float32), s0[None])
+        empty = jnp.zeros((1, 4, spec.nj, spec.ni), jnp.float32)
         t = (s0 * length)[None]                            # [1, Nj, Ni]
         return consume(carry, empty, t, t)
 
@@ -836,6 +877,23 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
 
         packed = march(consume, psg.init_seg_packed(k, nj, ni))
         color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
+    elif spec.fold == "pallas_fused":
+        # shade-in-kernel: the march feeds the raw resampled value plane
+        # and the kernel applies TF + opacity correction + depths itself
+        # (≅ the reference's one-kernel generation) — the 4-channel rgba
+        # and two depth streams never exist in HBM
+        length = axcam.ray_lengths()
+        ds = jnp.abs(axcam.dwm) / axcam.zp
+        ratio = ds * length / nominal_step(vol)
+
+        def consume(packed, val, sk):
+            return psg.fused_fold_chunk(packed, val, length, ratio, sk,
+                                        sk + ds, threshold, max_k=k, tf=tf)
+
+        packed = slice_march(vol, tf, axcam, spec, consume,
+                             psg.init_seg_packed(k, nj, ni), u_bounds,
+                             v_bounds, occupancy=occ, raw=True)
+        color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
     elif spec.fold == "seg":
         def consume(st, rgba, t0, t1):
             return sf.seg_fold_chunk(st, rgba, t0, t1, threshold, max_k=k)
@@ -960,11 +1018,26 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
             (pm.init_packed(k, nj, ni), jnp.zeros((nj, ni), jnp.int32)),
             u_bounds, v_bounds, occupancy=occ)
         color, depth = ss.finalize(pm.unpack_state(packed))
-    elif spec.fold in ("seg", "pallas_seg"):
+    elif spec.fold in ("seg", "pallas_seg", "pallas_fused"):
         # the segmented-scan fold's own running start count IS the true
         # per-pixel segment count — the temporal controller's feedback
         # signal comes out of the write fold for free
-        if spec.fold == "pallas_seg":
+        if spec.fold == "pallas_fused":
+            length = axcam.ray_lengths()
+            ds = jnp.abs(axcam.dwm) / axcam.zp
+            ratio = ds * length / nominal_step(vol)
+
+            def consume(packed, val, sk):
+                return psg.fused_fold_chunk(packed, val, length, ratio,
+                                            sk, sk + ds, thr, max_k=k,
+                                            tf=tf)
+
+            packed = slice_march(vol, tf, axcam, spec, consume,
+                                 psg.init_seg_packed(k, nj, ni),
+                                 u_bounds, v_bounds, occupancy=occ,
+                                 raw=True)
+            state = psg.unpack_seg_state(packed)
+        elif spec.fold == "pallas_seg":
             def consume(packed, rgba, t0, t1):
                 return psg.fold_chunk_packed(packed, rgba, t0, t1, thr,
                                              max_k=k)

@@ -43,26 +43,36 @@ def volume_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis_name, None, None))
 
 
-def halo_exchange_z(local: jnp.ndarray, axis_name: str = DEFAULT_AXIS
-                    ) -> jnp.ndarray:
-    """Pad a z-sharded block f32[Dn, H, W] with one neighbor slice on each
-    side via ``ppermute`` over ICI → f32[Dn+2, H, W].
+def halo_exchange_z(local: jnp.ndarray, axis_name: str = DEFAULT_AXIS,
+                    h: int = 1) -> jnp.ndarray:
+    """Pad a z-sharded block f32[Dn, H, W] with ``h`` neighbor slices on
+    each side via ``ppermute`` over ICI → f32[Dn+2h, H, W].
 
-    Edge ranks receive a clamped copy of their own boundary slice, matching
-    the single-device CLAMP_TO_EDGE sampling exactly — so distributed
-    trilinear interpolation is seam-exact vs a single-device render (the
-    reference's per-rank Volume nodes cannot interpolate across rank
-    boundaries at all).
+    Edge ranks receive clamped copies of their own boundary slice,
+    matching the single-device CLAMP_TO_EDGE sampling exactly — so
+    distributed trilinear interpolation (h=1) AND radius-deep
+    neighborhood operators like the AO box blur (h=radius+1) are
+    seam-exact vs a single-device render (the reference's per-rank Volume
+    nodes cannot interpolate across rank boundaries at all). ``h`` may
+    not exceed the slab depth — deeper halos would need multi-hop
+    exchanges; use fewer ranks or a smaller radius instead.
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
+    dn = local.shape[0]
+    if h > dn:
+        raise ValueError(
+            f"halo depth {h} exceeds the {dn}-slice slab — a neighbor "
+            "holds fewer slices than the halo needs (shrink ao_radius or "
+            "use fewer ranks / a deeper slab)")
+    clamp_bot = jnp.repeat(local[:1], h, axis=0)
+    clamp_top = jnp.repeat(local[-1:], h, axis=0)
     if n == 1:
-        return jnp.concatenate([local[:1], local, local[-1:]], axis=0)
-    # send my top slice to rank+1 (their bottom halo), bottom slice to rank-1
+        return jnp.concatenate([clamp_bot, local, clamp_top], axis=0)
     up = [(i, (i + 1) % n) for i in range(n)]
     down = [(i, (i - 1) % n) for i in range(n)]
-    from_below = jax.lax.ppermute(local[-1:], axis_name, up)     # rank r gets r-1's last
-    from_above = jax.lax.ppermute(local[:1], axis_name, down)    # rank r gets r+1's first
-    bottom = jnp.where(idx == 0, local[:1], from_below)
-    top = jnp.where(idx == n - 1, local[-1:], from_above)
+    from_below = jax.lax.ppermute(local[-h:], axis_name, up)   # r-1's last h
+    from_above = jax.lax.ppermute(local[:h], axis_name, down)  # r+1's first h
+    bottom = jnp.where(idx == 0, clamp_bot, from_below)
+    top = jnp.where(idx == n - 1, clamp_top, from_above)
     return jnp.concatenate([bottom, local, top], axis=0)

@@ -111,26 +111,51 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
     return jax.jit(f)
 
 
-def _rank_slab(local_data, origin, spacing, spec, axis, n):
+def _rank_slab(local_data, origin, spacing, spec, axis, n,
+               shade=None, shade_halo: int = 0):
     """This rank's halo-padded slab Volume + global box + ownership bounds
-    for a slice march (shared by generation and threshold seeding)."""
+    for a slice march (shared by generation and threshold seeding).
+
+    ``shade``: optional per-rank volume shader (e.g. the AO pre-shader,
+    ops/ao.shade_volume_ao) applied to a ``shade_halo``-deep extended
+    slab BEFORE trimming to the march extent — a radius-``shade_halo``
+    neighborhood operator inside ``shade`` then sees real neighbor
+    slices, making its output seam-exact vs a single-device run. The
+    shader may change the channel layout (scalar → pre-shaded RGBA)."""
     r = jax.lax.axis_index(axis)
     dn = local_data.shape[0]
     h, w = local_data.shape[1], local_data.shape[2]
     dz = spacing[2]
     gmax = origin + jnp.array([w, h, dn * n], jnp.float32) * spacing
 
+    if shade is not None:
+        hr = shade_halo + 1
+        ext = halo_exchange_z(local_data, axis, h=hr)
+        ext_origin = origin.at[2].add((r * dn - hr) * dz)
+        local_data = shade(Volume(ext, ext_origin, spacing)).data
+        # trim back: [hr:hr+dn] is the bare slab; the branches below
+        # re-add their own 1-slice interpolation halo from the REAL
+        # (already-shaded) neighbors kept around it
+        z_slice = lambda lo, hi: (local_data[..., lo:hi, :, :]
+                                  if local_data.ndim == 4
+                                  else local_data[lo:hi])
+
     if spec.axis == 2:
         # march along the domain axis: each rank marches only its own
         # slab slices — no halo, no ownership masks needed
         local_origin = origin.at[2].add(r * dn * dz)
+        if shade is not None:
+            local_data = z_slice(shade_halo + 1, shade_halo + 1 + dn)
         vol = Volume(local_data, local_origin, spacing)
         v_bounds = None
     else:
         # march along x/y: the in-plane v axis is the sharded z axis —
         # halo rows for seam-exact bilinear, half-open ownership so
         # every sample belongs to exactly one rank
-        halo = halo_exchange_z(local_data, axis)           # [Dn+2, H, W]
+        if shade is not None:
+            halo = z_slice(shade_halo, shade_halo + dn + 2)
+        else:
+            halo = halo_exchange_z(local_data, axis)       # [Dn+2, H, W]
         local_origin = origin.at[2].add((r * dn - 1) * dz)
         vol = Volume(halo, local_origin, spacing)
         z_lo = origin[2] + r * dn * dz
@@ -414,13 +439,30 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
 
+    # distributed AO: pre-shade each rank's slab with TF + occlusion on a
+    # radius-deep halo (seam-exact — see _rank_slab's shade hook), then
+    # march the pre-shaded volume with tf=None exactly like the
+    # single-device MXU AO path (ops/ao.shade_volume_ao)
+    ao_on = cfg.ao_strength > 0.0
+    if ao_on:
+        from scenery_insitu_tpu.ops import ao as _ao
+
+        shade = lambda v: _ao.shade_volume_ao(v, tf, cfg.ao_radius,
+                                              cfg.ao_strength)
+
     def step(local_data, origin, spacing, cam: Camera):
-        vol, gmax, v_bounds, _ = _rank_slab(local_data, origin, spacing,
-                                            spec, axis, n)
+        if ao_on:
+            vol, gmax, v_bounds, _ = _rank_slab(
+                local_data, origin, spacing, spec, axis, n,
+                shade=shade, shade_halo=cfg.ao_radius)
+        else:
+            vol, gmax, v_bounds, _ = _rank_slab(local_data, origin,
+                                                spacing, spec, axis, n)
         axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
                                         box_max=gmax)
-        out = slicer.render_slices(vol, tf, axcam, spec,
-                                   cfg.early_exit_alpha, v_bounds=v_bounds,
+        out = slicer.render_slices(vol, tf if not ao_on else None, axcam,
+                                   spec, cfg.early_exit_alpha,
+                                   v_bounds=v_bounds,
                                    step_scale=cfg.step_scale)
         images = _exchange_columns(out.image, n, axis)     # [n, 4, Nj, Ni/n]
         depths = _exchange_columns(out.depth, n, axis)     # [n, Nj, Ni/n]
@@ -452,20 +494,34 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
 
     # rank partials must stay background-free — the background is blended
     # exactly once, by the final composite (blending it per rank would
-    # occlude farther ranks for any non-transparent background). AO is
-    # also forced off: each rank's occlusion blur would edge-clamp at its
-    # 1-voxel halo instead of seeing the neighbor's ao_radius slices,
-    # banding the seams — AO is a single-device feature until radius-deep
-    # halos exist (ops/ao.py).
+    # occlude farther ranks for any non-transparent background).
+    # ao_strength is zeroed in the RANK config because the per-rank AO
+    # field is built here from a RADIUS-DEEP halo (h = ao_radius + 1, so
+    # each rank's occlusion blur sees the neighbor's slices; raycast's
+    # own cfg-driven field would blur the 1-halo slab and band the
+    # seams), then trimmed to the 1-halo extent the raycaster samples —
+    # seam-exact vs the single-device AO render.
     rank_cfg = dataclasses.replace(cfg, background=(0.0, 0.0, 0.0, 0.0),
                                    ao_strength=0.0)
+    ao_on = cfg.ao_strength > 0.0
 
     def step(local_data, origin, spacing, cam: Camera) -> jnp.ndarray:
         d_global = local_data.shape[0] * n
         vol, cmin, cmax = _local_volume_and_clip(local_data, origin, spacing,
                                                  d_global, axis)
+        ao_vol = None
+        if ao_on:
+            from scenery_insitu_tpu.ops import ao as _ao
+
+            dn = local_data.shape[0]
+            hr = cfg.ao_radius + 1
+            ext = halo_exchange_z(local_data, axis, h=hr)
+            occ = _ao.occlusion_field(
+                _ao.tf_alpha(Volume(ext, vol.origin, spacing), tf),
+                cfg.ao_radius, cfg.ao_strength)
+            ao_vol = Volume(occ[hr - 1:hr + dn + 1], vol.origin, spacing)
         out = raycast(vol, tf, cam, width, height, rank_cfg,
-                      clip_min=cmin, clip_max=cmax)
+                      clip_min=cmin, clip_max=cmax, ao_field=ao_vol)
         images = _exchange_columns(out.image, n, axis)     # [n, 4, H, W/n]
         depths = _exchange_columns(out.depth, n, axis)     # [n, H, W/n]
         return composite_plain(images, depths, cfg.background)

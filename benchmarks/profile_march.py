@@ -103,6 +103,30 @@ def main():
         return ss.finalize(stf)
     timeit(jax.jit(write_pass), vol.data, label="one writing march")
 
+    # the round-4 fold schedules head to head: ONE write march each
+    # (adaptive off -> fixed threshold, no counting pass), guarded per
+    # variant so a Mosaic rejection can't kill the rest of the profile
+    folds = ["xla", "seg"]
+    if jax.default_backend() == "tpu":
+        folds += ["pallas_seg", "pallas_fused"]
+    for fname in folds:
+        try:
+            spec_f = slicer.make_spec(cam, (grid, grid, grid),
+                                      SliceMarchConfig(fold=fname))
+
+            def wf(data, spec_f=spec_f):
+                v = Volume.centered(data, extent=2.0)
+                vdi, _, _ = slicer.generate_vdi_mxu(
+                    v, tf, cam, spec_f,
+                    VDIConfig(max_supersegments=k, adaptive=False,
+                              threshold=0.1))
+                return vdi.color
+
+            timeit(jax.jit(wf), vol.data, label=f"write march fold={fname}")
+        except Exception as e:
+            print(f"write march fold={fname}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:150]}", flush=True)
+
     # full VDI generation (ad_iters counting + 1 write)
     def gen(data):
         v = Volume.centered(data, extent=2.0)

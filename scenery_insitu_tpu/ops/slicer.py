@@ -438,6 +438,27 @@ def chunk_occupancy_vtiles(vol: Volume, tf: TransferFunction,
     return chunks, tiles
 
 
+def _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
+                     u_bounds, v_bounds, step_scale: float = 1.0):
+    """One write march through the fused shade+fold kernel (raw mode).
+    The length/ds/ratio geometry matches slice_march's own shading
+    formula INCLUDING step_scale — one implementation for both the plain
+    and temporal generators."""
+    length = axcam.ray_lengths()
+    ds = jnp.abs(axcam.dwm) / axcam.zp
+    ratio = ds * length / nominal_step(vol, step_scale)
+
+    def consume(packed, val, sk):
+        return psg.fused_fold_chunk(packed, val, length, ratio, sk,
+                                    sk + ds, threshold, max_k=k, tf=tf)
+
+    packed = slice_march(vol, tf, axcam, spec, consume,
+                         psg.init_seg_packed(k, spec.nj, spec.ni),
+                         u_bounds, v_bounds, step_scale=step_scale,
+                         occupancy=occ, raw=True)
+    return psg.unpack_seg_state(packed)
+
+
 def occupancy_for(vol: Volume, tf: TransferFunction, spec: AxisSpec):
     """The occupancy structure `slice_march` consumes for this spec:
     None (skipping off), bool[nchunks], or (chunk, tile) tuple when
@@ -708,14 +729,32 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     all-pixels predicate can essentially never fire)."""
 
     def consume(carry, rgba, t0, t1):
+        # chunk-parallel alpha-under (same factorization as the seg fold:
+        # contribution_s = rgba_s * prod_{s'<s}(1-alpha)), EXACT including
+        # the per-pixel saturation gate: the sequential gate tests the
+        # PRE-update accumulated alpha, which equals 1-(1-A0)*Tl_excl(s)
+        # — a prefix quantity — and once a pixel crosses, every later
+        # sample is zeroed either way, so masking with the unmasked
+        # prefix reproduces the frozen-accumulator semantics (up to fp
+        # association; a pixel landing within ~1 ulp of the threshold
+        # can round the gate differently and shift by one sample —
+        # measure-zero in practice, bounded by one sample's alpha).
         acc, first_t = carry
-        for i in range(rgba.shape[0]):
-            gate = (acc[3] < early_exit_alpha).astype(jnp.float32)
-            src = rgba[i] * gate[None]
-            acc = acc + (1.0 - acc[3:4]) * src
-            first_t = jnp.where((first_t == jnp.inf) & (src[3] > 1e-4),
-                                t0[i], first_t)
-        return acc, first_t
+        cc = rgba.shape[0]
+        t_run = jnp.ones_like(acc[3])
+        tls = []
+        for i in range(cc):                    # 2 ops/slice, tiny loop
+            tls.append(t_run)
+            t_run = t_run * (1.0 - rgba[i, 3])
+        tl = jnp.stack(tls)                                # [C, Nj, Ni]
+        a0 = acc[3:4]
+        a_pre = 1.0 - (1.0 - a0) * tl                      # [C, Nj, Ni]
+        gate = a_pre < early_exit_alpha
+        contrib = jnp.sum(rgba * (tl * gate)[:, None], axis=0)
+        acc = acc + (1.0 - a0) * contrib
+        hit = gate & (rgba[:, 3] > 1e-4)
+        t_hit = jnp.min(jnp.where(hit, t0, jnp.inf), axis=0)
+        return acc, jnp.minimum(first_t, t_hit)
 
     acc0 = jnp.zeros((4, spec.nj, spec.ni), jnp.float32)
     t0 = jnp.full((spec.nj, spec.ni), jnp.inf, jnp.float32)
@@ -882,18 +921,9 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         # and the kernel applies TF + opacity correction + depths itself
         # (≅ the reference's one-kernel generation) — the 4-channel rgba
         # and two depth streams never exist in HBM
-        length = axcam.ray_lengths()
-        ds = jnp.abs(axcam.dwm) / axcam.zp
-        ratio = ds * length / nominal_step(vol)
-
-        def consume(packed, val, sk):
-            return psg.fused_fold_chunk(packed, val, length, ratio, sk,
-                                        sk + ds, threshold, max_k=k, tf=tf)
-
-        packed = slice_march(vol, tf, axcam, spec, consume,
-                             psg.init_seg_packed(k, nj, ni), u_bounds,
-                             v_bounds, occupancy=occ, raw=True)
-        color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
+        state = _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
+                                 u_bounds, v_bounds)
+        color, depth = sf.seg_finalize(state)
     elif spec.fold == "seg":
         def consume(st, rgba, t0, t1):
             return sf.seg_fold_chunk(st, rgba, t0, t1, threshold, max_k=k)
@@ -1023,20 +1053,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         # per-pixel segment count — the temporal controller's feedback
         # signal comes out of the write fold for free
         if spec.fold == "pallas_fused":
-            length = axcam.ray_lengths()
-            ds = jnp.abs(axcam.dwm) / axcam.zp
-            ratio = ds * length / nominal_step(vol)
-
-            def consume(packed, val, sk):
-                return psg.fused_fold_chunk(packed, val, length, ratio,
-                                            sk, sk + ds, thr, max_k=k,
-                                            tf=tf)
-
-            packed = slice_march(vol, tf, axcam, spec, consume,
-                                 psg.init_seg_packed(k, nj, ni),
-                                 u_bounds, v_bounds, occupancy=occ,
-                                 raw=True)
-            state = psg.unpack_seg_state(packed)
+            state = _fused_vdi_march(vol, tf, axcam, spec, thr, k, occ,
+                                     u_bounds, v_bounds)
         elif spec.fold == "pallas_seg":
             def consume(packed, rgba, t0, t1):
                 return psg.fold_chunk_packed(packed, rgba, t0, t1, thr,

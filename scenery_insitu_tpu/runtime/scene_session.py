@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from scenery_insitu_tpu.config import FrameworkConfig
@@ -136,6 +137,71 @@ class SceneSession:
         advance_camera_and_index(self)
         self.timers.frame_done()
         return payload
+
+    def prewarm_regimes(self, regimes=None) -> dict:
+        """Precompile the render step for each (axis, sign) camera regime
+        against the CURRENT scene (same rationale as
+        InSituSession.prewarm_regimes: a regime crossing mid-session
+        otherwise stalls on a fresh jit). Call after `update_data` —
+        a later grid-set signature change recompiles regardless (the
+        cache is keyed on both). Temporal threshold state and the
+        reentry tracker are snapshotted and restored; the camera and
+        frame index are untouched. Returns {(axis, sign): seconds}."""
+        import time as _time
+
+        if self.scene.num_grids == 0:
+            raise RuntimeError("no grids; call update_data first")
+        # only the MXU VDI path compiles per regime — gather/plain steps
+        # have no regime dependence and would fill the bounded step cache
+        # with byte-identical duplicates
+        if self.engine != "mxu" or not self.cfg.runtime.generate_vdis:
+            return {}
+        from scenery_insitu_tpu.runtime.session import regime_camera
+
+        if regimes is None:
+            regimes = [(a, s) for a in (0, 1, 2) for s in (1, -1)]
+        cam0 = self.camera
+        thr0 = dict(self._thr)
+        had_last = hasattr(self, "_last_regime_key")
+        last0 = getattr(self, "_last_regime_key", None)
+        active_key = None
+        times = {}
+        try:
+            for regime in regimes:
+                cam = regime_camera(cam0, regime, self._slicer)
+                self.camera = cam
+                t0 = _time.perf_counter()
+                step, key = self._step()
+                gs = self.scene.grids
+                args = (tuple(g.volume.data for g in gs),
+                        tuple(g.volume.origin for g in gs),
+                        tuple(g.volume.spacing for g in gs), cam)
+                if self._temporal:
+                    thr = self._thr_init[key](*args)
+                    out, _ = step(*args, thr)
+                else:
+                    out = step(*args)
+                jax.block_until_ready(out)
+                times[tuple(regime)] = round(_time.perf_counter() - t0, 2)
+        finally:
+            self.camera = cam0
+            # drop restored threshold entries whose step was evicted by
+            # the cache bound (they would be orphaned forever), and keep
+            # the ACTIVE regime's step most-recent so prewarming many
+            # regimes can't evict the one the loop is about to use
+            self._thr = {kk: v for kk, v in thr0.items()
+                         if kk in self._steps}
+            try:
+                _, active_key = self._step()
+                if active_key in self._steps:
+                    self._steps[active_key] = self._steps.pop(active_key)
+            except Exception:
+                pass
+            if had_last:
+                self._last_regime_key = last0
+            elif hasattr(self, "_last_regime_key"):
+                del self._last_regime_key
+        return times
 
     def _step(self):
         """(jitted step, cache key) for the current camera regime and the

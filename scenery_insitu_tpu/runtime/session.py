@@ -76,6 +76,29 @@ def apply_tf_steering(sess, msg: dict, invalidate) -> None:
     invalidate()
 
 
+def regime_camera(cam0, regime, slicer_mod):
+    """Synthetic camera guaranteed to resolve to ``regime`` under
+    choose_axis: eye on the regime's axis at the original distance with a
+    small off-axis bias (stable argmax, up never parallel). ONE
+    implementation for every prewarm path — the synthesis must stay in
+    lockstep with choose_axis's convention. Raises on invalid regimes
+    (also the only validation of caller-supplied tuples)."""
+    a, s = regime
+    if a not in (0, 1, 2) or s not in (1, -1):
+        raise ValueError(f"invalid march regime {regime!r} "
+                         "(expected (axis in 0..2, sign ±1))")
+    eye = np.asarray(cam0.eye, np.float64)
+    tgt = np.asarray(cam0.target, np.float64)
+    dist = float(np.linalg.norm(eye - tgt)) or 2.5
+    off = np.full(3, 0.2 * dist)
+    off[a] = 0.0
+    new_eye = tgt.copy() - off
+    new_eye[a] = tgt[a] - s * dist
+    cam = cam0._replace(eye=jnp.asarray(new_eye, jnp.float32))
+    assert slicer_mod.choose_axis(cam) == (a, s)
+    return cam
+
+
 def drop_on_regime_reentry(sess, store: dict, key) -> None:
     """Shared temporal-threshold policy of both sessions: when the camera
     enters a regime key other than the previous frame's, drop that key's
@@ -442,26 +465,11 @@ class InSituSession:
         thr0 = dict(self._mxu_thr)
         had_last = hasattr(self, "_last_regime_key")
         last0 = getattr(self, "_last_regime_key", None)
-        eye = np.asarray(cam0.eye, np.float64)
-        tgt = np.asarray(cam0.target, np.float64)
-        dist = float(np.linalg.norm(eye - tgt)) or 2.5
         times = {}
         try:
             for regime in regimes:
                 a, s = regime
-                # eye placed so target-eye points down +s*axis, with a
-                # small off-axis bias (stable argmax, non-parallel up)
-                off = np.full(3, 0.2 * dist)
-                off[a] = 0.0
-                new_eye = tgt.copy() - off
-                new_eye[a] = tgt[a] - s * dist
-                cam = cam0._replace(eye=jnp.asarray(new_eye, jnp.float32))
-                if self._slicer.choose_axis(cam) != (a, s):
-                    # also the only validation of a caller-supplied
-                    # regime — a step compiled under the wrong key would
-                    # silently mislabel the cache and the timings
-                    raise ValueError(f"invalid march regime {regime!r} "
-                                     "(expected (axis in 0..2, sign ±1))")
+                cam = regime_camera(cam0, regime, self._slicer)
                 self.camera = cam
                 t0 = _time.perf_counter()
                 if self.mode == "hybrid":

@@ -288,3 +288,31 @@ def test_scene_session_temporal_reseeds_on_regime_reentry(vol, tf):
     sess.camera = cam_z                      # return: must re-seed
     sess.render_frame()
     assert sess._thr[key_z] is not stale
+
+
+def test_scene_session_prewarm_regimes(vol, tf):
+    """SceneSession.prewarm_regimes: precompiles per-regime steps for the
+    current scene, leaves camera/threshold/frame state untouched, and the
+    first real frame reuses the prewarmed step."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.runtime.scene_session import SceneSession
+
+    cfg = FrameworkConfig().with_overrides(
+        "vdi.max_supersegments=4", "vdi.adaptive_mode=temporal",
+        "composite.max_output_supersegments=6", "composite.adaptive_iters=1",
+        "slicer.engine=mxu", "slicer.matmul_dtype=f32",
+        "runtime.dataset=procedural")
+    sess = SceneSession(cfg)
+    sess.update_data(0, [np.asarray(vol.data)], [np.asarray(vol.origin)],
+                     vol.spacing)
+    start = sess._slicer.choose_axis(sess.camera)
+    eye0 = np.asarray(sess.camera.eye).copy()
+    times = sess.prewarm_regimes(regimes=[start, (0, 1)])
+    assert set(times) == {start, (0, 1)}
+    assert len(sess._steps) == 2
+    assert sess._thr == {}                 # invisible to the loop
+    assert sess.frame_index == 0
+    assert np.allclose(eye0, np.asarray(sess.camera.eye))
+    p = sess.render_frame()
+    assert np.isfinite(p["vdi_color"]).all()
+    assert len(sess._steps) == 2           # no third compile

@@ -2,9 +2,10 @@
 # Round-4 watcher. Same resumable skeleton as tpu_watcher_r3c.sh (probe
 # before EVERY step, output file = done marker, fail-bench after MAXFAIL
 # tunnel-alive failures) with the round-4 queue: the segmented-scan fold
-# measurements lead — they decide whether the round's redesign killed the
-# ~390 ms write-fold overhead (VERDICT round 3, item 1) — then the 512^3
-# flagship re-measure, the march-stage profile (item 2), the controlled
+# flagship leads (a ~3-minute window must yield the headline number),
+# then the fold-schedule microbench that decides whether the round's
+# redesign killed the ~390 ms write-fold overhead (VERDICT round 3,
+# item 1), the march-stage profile (item 2), the controlled
 # 256^3 round-2 A/B (item 6), chunk sweeps, the 1024^3 attempt (item 3),
 # and the round-3 diagnostics that never got a window.
 # Log: /tmp/tpu_watcher_r4.log
@@ -12,6 +13,13 @@ cd "$(dirname "$0")/.." || exit 1
 mkdir -p benchmarks/results
 R=benchmarks/results
 L=/tmp/tpu_watcher_r4.log
+# fail counters are POSITION-keyed; invalidate them when the step layout
+# changes (done-markers are filename-keyed and migrate on their own)
+LAYOUT=v2
+if [ "$(cat /tmp/r4_layout 2>/dev/null)" != "$LAYOUT" ]; then
+  rm -f /tmp/r4_fail.*
+  echo "$LAYOUT" > /tmp/r4_layout
+fi
 
 probe() {
   timeout 120 python - <<'EOF' 2>/dev/null
@@ -53,15 +61,16 @@ run_jsonl() {
 
 run_step() {  # run_step <n>
   case "$1" in
-    # 1: THE round-4 measurement — every fold schedule head to head at
-    # the flagship 512 scale, parity-checked (per-variant guarded).
-    1) run_jsonl "$R/fold_microbench_512_seg_r4.jsonl" 2400 \
-         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
-         --variants none,count,xla,seg,pallas_seg,pallas,fused,tf_pallas_seg,tf_xla_seg ;;
-    # 2: flagship 512^3 with the new default fold (auto -> pallas_seg)
-    2) run_json "$R/bench_tpu_r4_512.json" 1000 env \
+    # 1: flagship 512^3 with the new default fold (auto -> pallas_seg) —
+    # FIRST: a short window (window 2 was ~3 min) must yield the headline
+    1) run_json "$R/bench_tpu_r4_512.json" 1000 env \
          SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
          python bench.py ;;
+    # 2: THE round-4 diagnostic — every fold schedule head to head at
+    # the flagship 512 scale, parity-checked (per-variant guarded).
+    2) run_jsonl "$R/fold_microbench_512_seg_r4.jsonl" 2400 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --variants none,count,xla,seg,pallas_seg,pallas,fused,tf_pallas_seg,tf_xla_seg ;;
     # 3: same flagship on the pure-XLA seg fold (Mosaic-free A/B)
     3) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
@@ -116,7 +125,7 @@ run_step() {  # run_step <n>
          python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
     # 16: in-plane occupancy tiles A/B at the flagship scale (VERDICT
     # item 5) — early Gray-Scott frames are sparse, so vtiles=8 should
-    # show the (chunk x v-tile) skip against step 2's whole-slab run
+    # show the (chunk x v-tile) skip against step 1's whole-slab flagship
     16) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
          SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
@@ -130,8 +139,8 @@ run_step() {  # run_step <n>
 
 step_out() {
   case "$1" in
-    1) echo "$R/fold_microbench_512_seg_r4.jsonl" ;;
-    2) echo "$R/bench_tpu_r4_512.json" ;;
+    1) echo "$R/bench_tpu_r4_512.json" ;;
+    2) echo "$R/fold_microbench_512_seg_r4.jsonl" ;;
     3) echo "$R/bench_tpu_r4_512_segxla.json" ;;
     4) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
     5) echo "$R/profile_march_512_r4.txt" ;;

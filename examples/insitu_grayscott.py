@@ -37,6 +37,10 @@ def main():
                     help="also stream frames live over UDP on this port "
                          "(≅ the reference's UDP:3337 video stream; view "
                          "with runtime.streaming.VideoReceiver)")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="precompile every camera-regime step at startup "
+                    "(no mid-orbit compile stalls; see "
+                    "InSituSession.prewarm_regimes)")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", default="", help="checkpoint to resume from")
     ap.add_argument("--cpu", action="store_true",
@@ -88,6 +92,9 @@ def main():
     if args.resume:
         load_session(sess, args.resume)
         print(f"resumed at frame {sess.frame_index}")
+    if args.prewarm:
+        times = sess.prewarm_regimes()
+        print("prewarmed regimes:", {k: f"{v}s" for k, v in times.items()})
     try:
         sess.run(args.frames)
     finally:

@@ -18,7 +18,6 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from scenery_insitu_tpu.config import FrameworkConfig

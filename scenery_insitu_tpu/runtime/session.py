@@ -95,7 +95,11 @@ def regime_camera(cam0, regime, slicer_mod):
     new_eye = tgt.copy() - off
     new_eye[a] = tgt[a] - s * dist
     cam = cam0._replace(eye=jnp.asarray(new_eye, jnp.float32))
-    assert slicer_mod.choose_axis(cam) == (a, s)
+    if slicer_mod.choose_axis(cam) != (a, s):
+        # loud, -O-proof: a step compiled under a mislabeled regime key
+        # would silently poison the cache and the prewarm timings
+        raise RuntimeError(
+            f"regime_camera drifted from choose_axis for {regime!r}")
     return cam
 
 

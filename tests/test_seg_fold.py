@@ -215,3 +215,35 @@ def test_scalar_threshold_and_jit():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_with_vtiles_parity():
+    """fold='pallas_fused' composed with in-plane occupancy tiles: gated
+    row blocks emit the raw-mode -1 sentinel, which the fused kernel must
+    treat exactly like the zero-alpha samples the ungated march feeds."""
+    from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import Volume
+    from scenery_insitu_tpu.ops import slicer
+
+    data = np.zeros((32, 32, 32), np.float32)
+    data[4:12, 5:14, 6:16] = 0.8           # sparse corner blob
+    vol = Volume.centered(jnp.asarray(data), extent=2.0)
+    tf = for_dataset("procedural")
+    cam = Camera.create((0.2, 0.3, 2.8), fov_y_deg=45.0, near=0.3,
+                        far=10.0)
+    cfg = VDIConfig(max_supersegments=5, adaptive=False, threshold=0.3)
+
+    def gen(fold, vt):
+        spec = slicer.make_spec(
+            cam, vol.data.shape,
+            SliceMarchConfig(matmul_dtype="f32", scale=1.0, fold=fold,
+                             occupancy_vtiles=vt))
+        vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec, cfg)
+        return np.asarray(vdi.color), np.asarray(vdi.depth)
+
+    c_ref, d_ref = gen("xla", 0)
+    c_f, d_f = gen("pallas_fused", 4)
+    np.testing.assert_allclose(c_f, c_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(d_f, d_ref, rtol=1e-5, atol=1e-5)

@@ -247,3 +247,19 @@ def test_fused_with_vtiles_parity():
     c_f, d_f = gen("pallas_fused", 4)
     np.testing.assert_allclose(c_f, c_ref, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(d_f, d_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_k1_everything_merges():
+    """max_k=1: the machine's merge-overflow degenerates to 'one slot
+    absorbs the whole stream'; the seg formulation must reproduce it
+    (single reset at the first non-empty item, no resets after)."""
+    h, w = 8, 16
+    rgba, t0, t1 = _stream(jax.random.PRNGKey(5), 14, h, w)
+    thr = jnp.zeros((h, w), jnp.float32)   # break at every color change
+    c_ref, d_ref, n_ref = _ref(rgba, t0, t1, thr, 1)
+    c_s, d_s, n_s = _seg(rgba, t0, t1, thr, 1, (7, 7))
+    np.testing.assert_array_equal(np.asarray(n_s), np.asarray(n_ref))
+    np.testing.assert_allclose(np.asarray(c_s), np.asarray(c_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
+                               rtol=1e-5, atol=1e-5)

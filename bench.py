@@ -147,7 +147,7 @@ def main():
 
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
     march_cfg = SliceMarchConfig(fold=fold, chunk=chunk,
-                             occupancy_vtiles=vtiles)
+                                 occupancy_vtiles=vtiles)
     frame_step = grayscott_vdi_frame_step(
         width, height, sim_steps=sim_steps, max_steps=steps,
         vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,

@@ -86,6 +86,29 @@ def unpack_seg_state(packed) -> sf.SegFoldState:
         prev_empty=small[_PREV_EMPTY] > 0.5)
 
 
+def _phase_b(ev_slot, ev_rgba, t0_of, t1_of, ci_, di_, co, do_,
+             max_k: int):
+    """Rolled K-loop merge shared by the seg and fused kernels: per slot
+    row, masked-sum the per-slice records and under-merge into the
+    aliased [K,...] state (touched once per chunk). ``t0_of(m)``/
+    ``t1_of(m)`` produce the masked depth candidates for a slot mask so
+    each kernel can source depths from its own layout."""
+    def slot_body(kk, _):
+        m = ev_slot == kk.astype(jnp.float32)
+        mf = m.astype(jnp.float32)
+        contrib = jnp.sum(ev_rgba * mf[:, None], axis=0)
+        d0 = jnp.min(t0_of(m), axis=0)
+        d1 = jnp.max(t1_of(m), axis=0)
+        oc = ci_[pl.dslice(kk, 1)]
+        co[pl.dslice(kk, 1)] = oc + (1.0 - oc[:, 3:4]) * contrib[None]
+        dr = di_[pl.dslice(kk, 1)]
+        do_[pl.dslice(kk, 1)] = jnp.stack(
+            [jnp.minimum(dr[0, 0], d0), jnp.maximum(dr[0, 1], d1)])[None]
+        return 0
+
+    jax.lax.fori_loop(0, max_k, slot_body, 0)
+
+
 def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
                 co, do_, smo, ev_ref, *, max_k: int):
     nc = rgba_ref.shape[0]
@@ -119,21 +142,11 @@ def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
         run_cnt[None], pr, pe.astype(jnp.float32)[None]])
 
     # ---- phase B: rolled K loop, state touched once per chunk
-    def slot_body(kk, _):
-        ev = ev_ref[...]                                   # [C, 5, TH, WB]
-        m = ev[:, 0] == kk.astype(jnp.float32)
-        mf = m.astype(jnp.float32)
-        contrib = jnp.sum(ev[:, 1:5] * mf[:, None], axis=0)
-        d0 = jnp.min(jnp.where(m, td_ref[:, 0], jnp.inf), axis=0)
-        d1 = jnp.max(jnp.where(m, td_ref[:, 1], -jnp.inf), axis=0)
-        oc = ci_[pl.dslice(kk, 1)]                         # [1, 4, TH, WB]
-        co[pl.dslice(kk, 1)] = oc + (1.0 - oc[:, 3:4]) * contrib[None]
-        dr = di_[pl.dslice(kk, 1)]
-        do_[pl.dslice(kk, 1)] = jnp.stack(
-            [jnp.minimum(dr[0, 0], d0), jnp.maximum(dr[0, 1], d1)])[None]
-        return 0
-
-    jax.lax.fori_loop(0, max_k, slot_body, 0)
+    ev = ev_ref[...]                                       # [C, 5, TH, WB]
+    _phase_b(ev[:, 0], ev[:, 1:5],
+             lambda m: jnp.where(m, td_ref[:, 0], jnp.inf),
+             lambda m: jnp.where(m, td_ref[:, 1], -jnp.inf),
+             ci_, di_, co, do_, max_k)
 
 
 def _floats_per_px(c: int, k: int) -> int:
@@ -293,21 +306,11 @@ def _fused_kernel(val_ref, len_ref, ratio_ref, thr_ref, sk0_ref, sk1_ref,
     smo[...] = jnp.concatenate([
         run_cnt[None], pr, pe.astype(jnp.float32)[None]])
 
-    def slot_body(kk, _):
-        ev = ev_ref[...]                                   # [C, 7, TH, WB]
-        m = ev[:, 0] == kk.astype(jnp.float32)
-        mf = m.astype(jnp.float32)
-        contrib = jnp.sum(ev[:, 1:5] * mf[:, None], axis=0)
-        d0 = jnp.min(jnp.where(m, ev[:, 5], jnp.inf), axis=0)
-        d1 = jnp.max(jnp.where(m, ev[:, 6], -jnp.inf), axis=0)
-        oc = ci_[pl.dslice(kk, 1)]
-        co[pl.dslice(kk, 1)] = oc + (1.0 - oc[:, 3:4]) * contrib[None]
-        dr = di_[pl.dslice(kk, 1)]
-        do_[pl.dslice(kk, 1)] = jnp.stack(
-            [jnp.minimum(dr[0, 0], d0), jnp.maximum(dr[0, 1], d1)])[None]
-        return 0
-
-    jax.lax.fori_loop(0, max_k, slot_body, 0)
+    ev = ev_ref[...]                                       # [C, 7, TH, WB]
+    _phase_b(ev[:, 0], ev[:, 1:5],
+             lambda m: jnp.where(m, ev[:, 5], jnp.inf),
+             lambda m: jnp.where(m, ev[:, 6], -jnp.inf),
+             ci_, di_, co, do_, max_k)
 
 
 def _fused_fpp(c: int, k: int) -> int:

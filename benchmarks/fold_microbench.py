@@ -30,6 +30,9 @@ for each schedule:
   fused        shade-in-kernel seg fold (ops/pallas_seg.fused_fold_chunk,
                fold="pallas_fused"): consumes the 1-channel raw VALUE
                stream, TF + opacity + depths computed in-kernel
+  fused_stream whole-march fused fold (fold="fused_stream"): chunk loop
+               inside the kernel grid, [K] state VMEM-resident per strip
+               (one HBM round trip per march); stream pre-materialized
   tf_pallas_seg / tf_xla_seg
                same value stream shaded in XLA feeding pallas_seg / seg —
                the controlled baselines for 'fused' (this family is
@@ -421,7 +424,8 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
             packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
                                      jnp.arange(nchunks))
             return sfold.seg_finalize(psg.unpack_seg_state(packed))
-    elif variant in ("fused", "tf_pallas_seg", "tf_xla_seg"):
+    elif variant in ("fused", "fused_stream", "tf_pallas_seg",
+                     "tf_xla_seg"):
         # VAL-STREAM family: same raw value stream, shading either
         # in-kernel (fused) or in XLA feeding a seg fold — the direct
         # measure of what fusing the TF + depth streams into the kernel
@@ -439,6 +443,26 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
                         max_k=k, tf=tf), None
                 packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
                                          jnp.arange(nchunks))
+                return sfold.seg_finalize(psg.unpack_seg_state(packed))
+        elif variant == "fused_stream":
+            def run():
+                # materialize the whole value stream (the march's matmul
+                # phase would write this buffer), then ONE whole-march
+                # pallas_call with the [K] state VMEM-resident per strip
+                def fill(carry, ci):
+                    buf, skb = carry
+                    val, sk = stream_val_chunk(ci, c, h, w)
+                    buf = jax.lax.dynamic_update_slice(buf, val,
+                                                       (ci * c, 0, 0))
+                    skb = jax.lax.dynamic_update_slice(skb, sk, (ci * c,))
+                    return (buf, skb), None
+                (buf, skb), _ = jax.lax.scan(
+                    fill, (jnp.zeros((s_total, h, w), jnp.float32),
+                           jnp.zeros((s_total,), jnp.float32)),
+                    jnp.arange(nchunks))
+                packed = psg.fused_stream_fold(
+                    psg.init_seg_packed(k, h, w), buf, length, ratio,
+                    skb, skb + ds, thr, max_k=k, chunk=c, tf=tf)
                 return sfold.seg_finalize(psg.unpack_seg_state(packed))
         elif variant == "tf_pallas_seg":
             def run():
@@ -593,7 +617,8 @@ def main():
           file=sys.stderr, flush=True)
 
     timed_variants = [v.strip() for v in args.variants.split(",")]
-    _VAL_FAMILY = ("fused", "tf_pallas_seg", "tf_xla_seg")
+    _VAL_FAMILY = ("fused", "fused_stream", "tf_pallas_seg",
+                   "tf_xla_seg")
     if args.check:
         ref = jax.jit(build("xla", s_total, args.chunk, args.k, h, w))()
         # the val-stream family consumes a different (raw value) stream:

@@ -14,8 +14,12 @@ mkdir -p benchmarks/results
 R=benchmarks/results
 L=/tmp/tpu_watcher_r4.log
 # fail counters are POSITION-keyed; invalidate them when the step layout
-# changes (done-markers are filename-keyed and migrate on their own)
-LAYOUT=v2
+# changes (done-markers are filename-keyed and migrate on their own —
+# NOTE: a step whose COMMAND changes while keeping its filename must
+# also rename its artifact if that artifact already exists; as of the
+# v3 layout no r4 artifact had ever been produced, so the microbench
+# variant additions kept their names)
+LAYOUT=v3
 if [ "$(cat /tmp/r4_layout 2>/dev/null)" != "$LAYOUT" ]; then
   rm -f /tmp/r4_fail.*
   echo "$LAYOUT" > /tmp/r4_layout
@@ -70,7 +74,7 @@ run_step() {  # run_step <n>
     # the flagship 512 scale, parity-checked (per-variant guarded).
     2) run_jsonl "$R/fold_microbench_512_seg_r4.jsonl" 2400 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
-         --variants none,count,xla,seg,pallas_seg,pallas,fused,tf_pallas_seg,tf_xla_seg ;;
+         --variants none,count,xla,seg,pallas_seg,pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
     # 3: same flagship on the pure-XLA seg fold (Mosaic-free A/B)
     3) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
@@ -79,7 +83,7 @@ run_step() {  # run_step <n>
     # round-3 numbers (xla 15.4 / two-phase pallas 16.0 ms per march)
     4) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
-         --variants none,count,xla,seg,pallas_seg,pallas,fused,tf_pallas_seg,tf_xla_seg ;;
+         --variants none,count,xla,seg,pallas_seg,pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
     # 5: march-stage profile at the flagship scale (VERDICT item 2: where
     # do the ~34 counting-march ms go — einsums, TF, opacity, fold?)
     5) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
@@ -134,6 +138,11 @@ run_step() {  # run_step <n>
     17) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
          SITPU_BENCH_FOLD=pallas_fused SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 18: flagship on the whole-march stream fold — [K] state crosses
+    # HBM once per march (the endgame fold schedule)
+    18) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
+         SITPU_BENCH_FOLD=fused_stream SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
   esac
 }
 
@@ -156,10 +165,11 @@ step_out() {
     15) echo "$R/profile_frame_tpu_r4.json" ;;
     16) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
     17) echo "$R/bench_tpu_r4_512_fused.json" ;;
+    18) echo "$R/bench_tpu_r4_512_fstream.json" ;;
   esac
 }
 
-NSTEPS=17
+NSTEPS=18
 MAXFAIL=2
 for i in $(seq 1 500); do
   next=""

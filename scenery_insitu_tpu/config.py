@@ -117,9 +117,15 @@ class SliceMarchConfig:
     #   "seg"        round-4 segmented-scan fold (ops/seg_fold.py): start
     #                flags / segment ids / transmittance all data-parallel,
     #                [K] state touched once per chunk;
-    #   "pallas_seg" the seg fold's VMEM pixel-strip twin (ops/pallas_seg.py,
-    #                ≅ the reference's single-kernel generation,
+    #   "pallas_seg" the seg fold's VMEM pixel-strip twin (ops/pallas_seg.py);
+    #   "pallas_fused" shade-in-kernel: the TF + opacity correction +
+    #                depth streams move into the fold kernel (≅ the
+    #                reference's single-kernel generation,
     #                VDIGenerator.comp + AccumulateVDI.comp);
+    #   "fused_stream" the whole-march fused fold: chunk loop inside the
+    #                kernel grid, [K] state VMEM-resident per pixel strip
+    #                (one HBM round trip per march; costs a f32[S,Nj,Ni]
+    #                stream buffer);
     #   "auto"       pallas_seg on TPU (compile-probe gated, falling back
     #                to seg), xla elsewhere.
     fold: str = "auto"

@@ -230,10 +230,10 @@ def _tf_consts(tf) -> tuple:
         cb = np.asarray(tf.color_b).tolist()
     except Exception as e:
         raise ValueError(
-            "fold='pallas_fused' bakes the transfer function into the "
-            "kernel and needs a CONCRETE TransferFunction (got traced "
-            f"values: {e}); pass the TF as a closure constant or use "
-            "fold='pallas_seg'") from None
+            "the fused fold schedules (pallas_fused / fused_stream) bake "
+            "the transfer function into the kernel and need a CONCRETE "
+            f"TransferFunction (got traced values: {e}); pass the TF as "
+            "a closure constant or use fold='pallas_seg'") from None
     return (tuple(ax), tuple(am), ab, tuple(cx),
             tuple(tuple(r) for r in cm), tuple(cb))
 
@@ -365,6 +365,96 @@ def fused_fold_chunk(packed, val: jnp.ndarray, length: jnp.ndarray,
         out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype) for p in packed],
         scratch_shapes=[pltpu.VMEM((c, 7, TILE_H, wb), jnp.float32)],
         input_output_aliases={6: 0, 7: 1, 8: 2},
+        interpret=interpret,
+    )(val, length, ratio, threshold, sk0, sk1, *packed)
+    return tuple(out)
+
+
+# ------------------------------------------- whole-march stream-fold kernel
+
+
+def _fused_stream_kernel(val_ref, len_ref, ratio_ref, thr_ref, sk0_ref,
+                         sk1_ref, ci_, di_, smi_, co, do_, smo, ev_ref, *,
+                         max_k: int, tfc: tuple):
+    """The fused shade+fold kernel over a WHOLE-march grid: the chunk
+    loop is the innermost grid dimension and every state block's index
+    map ignores it, so Mosaic keeps the [K,...] state resident in VMEM
+    across all chunks of a pixel strip and writes it back ONCE — the
+    state's HBM traffic drops from (2 x per chunk) to (1 x per march),
+    the last memory term the per-chunk kernels still paid. The val
+    stream must pre-exist in HBM (f32[S,H,W], built by the march's
+    matmul phase), which the 1-channel fused feed makes affordable.
+    Phase logic is identical to `_fused_kernel`; cross-chunk
+    continuation works exactly as between per-chunk calls because phase
+    B merges into the (now VMEM-resident) state after every chunk.
+
+    Accumulation reads/writes the OUTPUT refs (initialized from the
+    aliased inputs at the strip's first chunk): a revisited block only
+    persists on the output side — re-reading the input refs after
+    chunk 0 would see the strip's INITIAL state, not the accumulated
+    one (the standard Pallas grid-accumulator pattern)."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        co[...] = ci_[...]
+        do_[...] = di_[...]
+        smo[...] = smi_[...]
+
+    _fused_kernel(val_ref, len_ref, ratio_ref, thr_ref, sk0_ref, sk1_ref,
+                  co, do_, smo, co, do_, smo, ev_ref,
+                  max_k=max_k, tfc=tfc)
+
+
+def fused_stream_fold(packed, val: jnp.ndarray, length: jnp.ndarray,
+                      ratio: jnp.ndarray, sk0: jnp.ndarray,
+                      sk1: jnp.ndarray, threshold: jnp.ndarray, *,
+                      max_k: int, chunk: int, tf,
+                      interpret: Optional[bool] = None):
+    """Fold an ENTIRE pre-materialized value stream in one pallas_call.
+
+    val f32[S,H,W] (S a multiple of ``chunk``; -1 sentinel for dead
+    samples); sk0/sk1 f32[S] per-slice depth ratios; length/ratio/
+    threshold f32[H,W]. ``packed`` = `init_seg_packed` triple. The fold
+    state crosses HBM once per strip instead of once per chunk."""
+    if interpret is None:
+        interpret = should_interpret()
+    tfc = _tf_consts(tf)
+    color, depth, small = packed
+    kk = color.shape[0]
+    _, _, h, w = color.shape
+    s_total = val.shape[0]
+    c = chunk
+    if s_total % c:
+        raise ValueError(f"stream length {s_total} not a multiple of "
+                         f"chunk {c}")
+    if h % TILE_H:
+        raise ValueError(f"height {h} not a multiple of {TILE_H}")
+    threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
+    length = jnp.broadcast_to(jnp.asarray(length, jnp.float32), (h, w))
+    ratio = jnp.broadcast_to(jnp.asarray(ratio, jnp.float32), (h, w))
+    sk0 = jnp.asarray(sk0, jnp.float32).reshape(s_total, 1, 1)
+    sk1 = jnp.asarray(sk1, jnp.float32).reshape(s_total, 1, 1)
+
+    wb = _pick_block_w(w, 4 * TILE_H * _fused_fpp(c, kk))
+    nchunks = s_total // c
+    # chunk dim INNERMOST (fastest): for each strip, all chunks run
+    # consecutively and the constant-index state blocks are revisited
+    grid = (h // TILE_H, pl.cdiv(w, wb), nchunks)
+    row = lambda *lead: pl.BlockSpec(
+        lead + (TILE_H, wb), lambda j, i, ci: (0,) * len(lead) + (j, i))
+    stream = pl.BlockSpec((c, TILE_H, wb), lambda j, i, ci: (ci, j, i))
+    sk_spec = pl.BlockSpec((c, 1, 1), lambda j, i, ci: (ci, 0, 0))
+    state_specs = [row(kk, 4), row(kk, 2), row(_NSMALL)]
+    out = pl.pallas_call(
+        functools.partial(_fused_stream_kernel, max_k=max_k, tfc=tfc),
+        grid=grid,
+        in_specs=[stream, row(), row(), row(), sk_spec, sk_spec]
+        + state_specs,
+        out_specs=state_specs,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype) for p in packed],
+        scratch_shapes=[pltpu.VMEM((c, 7, TILE_H, wb), jnp.float32)],
+        input_output_aliases={6: 0, 7: 1, 8: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(val, length, ratio, threshold, sk0, sk1, *packed)
     return tuple(out)

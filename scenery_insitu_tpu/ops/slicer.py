@@ -171,10 +171,11 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
                     and pm.count_compile_ok(32, cfg.chunk, ni) else "seg")
         else:
             fold = "xla"
-    if fold not in ("xla", "pallas", "seg", "pallas_seg", "pallas_fused"):
+    if fold not in ("xla", "pallas", "seg", "pallas_seg", "pallas_fused",
+                    "fused_stream"):
         raise ValueError(f"unknown fold schedule {fold!r} (expected 'auto', "
-                         "'xla', 'pallas', 'seg', 'pallas_seg' or "
-                         "'pallas_fused')")
+                         "'xla', 'pallas', 'seg', 'pallas_seg', "
+                         "'pallas_fused' or 'fused_stream')")
     # clamp the tile count to what the geometry supports: each band needs
     # >= 2 volume rows (the apron + a zero-size reduction guard) and each
     # output block >= 2 rows — a too-large request degrades to coarser
@@ -459,6 +460,43 @@ def _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
     return psg.unpack_seg_state(packed)
 
 
+def _fused_stream_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
+                            u_bounds, v_bounds, step_scale: float = 1.0):
+    """Two-phase whole-march fused fold: phase M materializes the raw
+    value stream (the matmul phase, chunk-skipping intact — skipped
+    chunks write -1 planes), then ONE pallas_call folds the entire
+    stream with the [K] state VMEM-resident per strip
+    (ops/pallas_seg.fused_stream_fold). Costs a f32[S,Nj,Ni] stream
+    buffer (537 MB at the 512^3 flagship scale) — the chunked
+    fold="pallas_fused" is the memory-constrained alternative
+    (e.g. 1024^3, where this buffer would be 6.7 GB)."""
+    length = axcam.ray_lengths()
+    ds = jnp.abs(axcam.dwm) / axcam.zp
+    ratio = ds * length / nominal_step(vol, step_scale)
+    c = spec.chunk
+    # static slice count straight from the shape — permute_volume here
+    # would materialize a full transposed copy in eager execution
+    s_total = vol.data.shape[_DATA_DIM[spec.axis]]
+    s_pad = -(-s_total // c) * c
+
+    def consume(carry, val, sk):
+        buf, skb, idx = carry
+        buf = jax.lax.dynamic_update_slice(buf, val, (idx * c, 0, 0))
+        skb = jax.lax.dynamic_update_slice(skb, sk, (idx * c,))
+        return buf, skb, idx + 1
+
+    buf0 = jnp.zeros((s_pad, spec.nj, spec.ni), jnp.float32)
+    sk0 = jnp.zeros((s_pad,), jnp.float32)
+    buf, skb, _ = slice_march(vol, tf, axcam, spec, consume,
+                              (buf0, sk0, jnp.int32(0)), u_bounds,
+                              v_bounds, step_scale=step_scale,
+                              occupancy=occ, raw=True, raw_full_skip=True)
+    packed = psg.fused_stream_fold(
+        psg.init_seg_packed(k, spec.nj, spec.ni), buf, length, ratio,
+        skb, skb + ds, threshold, max_k=k, chunk=c, tf=tf)
+    return psg.unpack_seg_state(packed)
+
+
 def occupancy_for(vol: Volume, tf: TransferFunction, spec: AxisSpec):
     """The occupancy structure `slice_march` consumes for this spec:
     None (skipping off), bool[nchunks], or (chunk, tile) tuple when
@@ -474,7 +512,8 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 spec: AxisSpec, consume: Callable, carry0,
                 u_bounds=None, v_bounds=None, step_scale: float = 1.0,
                 occupancy: Optional[jnp.ndarray] = None,
-                early_stop: Optional[Callable] = None, raw: bool = False):
+                early_stop: Optional[Callable] = None, raw: bool = False,
+                raw_full_skip: bool = False):
     """The chunked slice march. Calls ``consume(carry, rgba [C,4,Nj,Ni],
     t0 [C,Nj,Ni], t1 [C,Nj,Ni]) -> carry`` for each chunk of slices, front
     to back, and returns the final carry.
@@ -654,6 +693,13 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
         s0 = jnp.float32(spec.sign) * (local_w0 + ci * c * axcam.dwm - ew) \
             / axcam.zp
         if raw:
+            if raw_full_skip:
+                # stream builders need every chunk at full C rows: emit
+                # the whole chunk of -1 sentinels + its true depth ratios
+                sk_c = s0 + jnp.arange(c, dtype=jnp.float32) * ds
+                return consume(carry,
+                               jnp.full((c, spec.nj, spec.ni), -1.0,
+                                        jnp.float32), sk_c)
             return consume(carry,
                            jnp.full((1, spec.nj, spec.ni), -1.0,
                                     jnp.float32), s0[None])
@@ -916,13 +962,17 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
 
         packed = march(consume, psg.init_seg_packed(k, nj, ni))
         color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
-    elif spec.fold == "pallas_fused":
+    elif spec.fold in ("pallas_fused", "fused_stream"):
         # shade-in-kernel: the march feeds the raw resampled value plane
         # and the kernel applies TF + opacity correction + depths itself
         # (≅ the reference's one-kernel generation) — the 4-channel rgba
-        # and two depth streams never exist in HBM
-        state = _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
-                                 u_bounds, v_bounds)
+        # and two depth streams never exist in HBM. fused_stream further
+        # moves the chunk loop inside the kernel grid (state resident in
+        # VMEM per strip, one HBM round trip per march).
+        marcher = (_fused_stream_vdi_march if spec.fold == "fused_stream"
+                   else _fused_vdi_march)
+        state = marcher(vol, tf, axcam, spec, threshold, k, occ,
+                        u_bounds, v_bounds)
         color, depth = sf.seg_finalize(state)
     elif spec.fold == "seg":
         def consume(st, rgba, t0, t1):
@@ -964,7 +1014,7 @@ def _histogram_threshold(march, cfg: VDIConfig, k: int, nj: int, ni: int,
 
     # any pallas fold implies a TPU backend where the VMEM counting
     # kernel is also the right schedule for the histogram march
-    if fold.startswith("pallas"):
+    if fold.startswith("pallas") or fold == "fused_stream":
         def consume_multi(carry, rgba, t0, t1):
             return pm.count_multi_chunk(carry, rgba, tvec)
 
@@ -1048,13 +1098,17 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
             (pm.init_packed(k, nj, ni), jnp.zeros((nj, ni), jnp.int32)),
             u_bounds, v_bounds, occupancy=occ)
         color, depth = ss.finalize(pm.unpack_state(packed))
-    elif spec.fold in ("seg", "pallas_seg", "pallas_fused"):
+    elif spec.fold in ("seg", "pallas_seg", "pallas_fused",
+                       "fused_stream"):
         # the segmented-scan fold's own running start count IS the true
         # per-pixel segment count — the temporal controller's feedback
         # signal comes out of the write fold for free
-        if spec.fold == "pallas_fused":
-            state = _fused_vdi_march(vol, tf, axcam, spec, thr, k, occ,
-                                     u_bounds, v_bounds)
+        if spec.fold in ("pallas_fused", "fused_stream"):
+            marcher = (_fused_stream_vdi_march
+                       if spec.fold == "fused_stream"
+                       else _fused_vdi_march)
+            state = marcher(vol, tf, axcam, spec, thr, k, occ,
+                            u_bounds, v_bounds)
         elif spec.fold == "pallas_seg":
             def consume(packed, rgba, t0, t1):
                 return psg.fold_chunk_packed(packed, rgba, t0, t1, thr,

@@ -146,7 +146,8 @@ def test_pallas_seg_matches_xla_seg():
                                    rtol=1e-6, atol=1e-6, err_msg=name)
 
 
-@pytest.mark.parametrize("fold", ["seg", "pallas_seg", "pallas_fused"])
+@pytest.mark.parametrize("fold", ["seg", "pallas_seg", "pallas_fused",
+                                  "fused_stream"])
 def test_whole_march_parity(fold):
     """generate_vdi_mxu + temporal: the seg folds must reproduce the
     sequential-machine fold end to end, including the temporal threshold

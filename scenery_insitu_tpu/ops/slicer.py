@@ -467,7 +467,7 @@ def _fused_stream_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
     chunks write -1 planes), then ONE pallas_call folds the entire
     stream with the [K] state VMEM-resident per strip
     (ops/pallas_seg.fused_stream_fold). Costs a f32[S,Nj,Ni] stream
-    buffer (537 MB at the 512^3 flagship scale) — the chunked
+    buffer (~840 MB at the 512^3 flagship scale: 512 x 640^2 x 4 B) — the chunked
     fold="pallas_fused" is the memory-constrained alternative
     (e.g. 1024^3, where this buffer would be 6.7 GB)."""
     length = axcam.ray_lengths()

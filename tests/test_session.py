@@ -233,3 +233,30 @@ def test_session_pending_meta_bounded_headless():
     sess = InSituSession(_cfg(), mesh=make_mesh(2))
     sess.run(6, fetch=False)
     assert len(sess._pending_meta) <= 2
+
+
+def test_session_prewarm_covers_orbit_crossing():
+    """The verdict-8 'done' criterion, compile-count form: an orbit that
+    CROSSES march regimes mid-run must find every step prewarmed — zero
+    new compilations after startup (on hardware that is the 10-24 s
+    mid-orbit stall; on CPU the cache count is the compile-free proxy)."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+
+    cfg = FrameworkConfig().with_overrides(
+        "slicer.engine=mxu", "slicer.scale=1.0",
+        "sim.grid=[16,16,16]", "sim.steps_per_frame=1",
+        "vdi.max_supersegments=4", "vdi.adaptive_mode=temporal",
+        "composite.max_output_supersegments=6", "mesh.num_devices=2")
+    s = InSituSession(cfg, mesh=make_mesh(2))
+    times = s.prewarm_regimes()
+    assert len(times) == 6
+    n_steps = len(s._mxu_steps)
+    assert n_steps == 6
+    # ~0.6 rad/frame crosses at least one regime boundary within 6 frames
+    s.orbit_rate = 0.6
+    payload = s.run(6)
+    assert np.isfinite(payload["vdi_color"]).all()
+    # the premise must actually hold: temporal mode seeds one threshold
+    # entry per VISITED regime, so >= 2 proves the orbit really crossed
+    assert len(s._mxu_thr) >= 2
+    assert len(s._mxu_steps) == n_steps     # nothing compiled mid-orbit

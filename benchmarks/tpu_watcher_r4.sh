@@ -103,10 +103,10 @@ run_step() {  # run_step <n>
     # einsum batches grow) at 512
     8) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
-         --chunk 32 --variants xla,seg,pallas_seg ;;
+         --chunk 32 --variants xla,seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
     9) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
-         --chunk 64 --variants seg,pallas_seg ;;
+         --chunk 64 --variants seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
     # 10: flagship at chunk 32 if the sweep says it matters
     10) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
          SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \

@@ -48,8 +48,9 @@ _CNT, _PREV_RGB, _PREV_EMPTY = 0, slice(1, 4), 4
 _NSMALL = 5
 # estimate floor on K so the chosen block width (and thus the exact kernel
 # Mosaic compiles) is identical for every K <= _EST_K and matches the
-# compile probe's geometry — same invariance argument as pallas_march._EST_K
-_EST_K = 32
+# compile probe's geometry. The floor actually applied lives in
+# pallas_march (strip_fpp uses it); alias it so the two can never diverge.
+from scenery_insitu_tpu.ops.pallas_march import _EST_K  # noqa: F401
 
 
 def init_seg_packed(k: int, height: int, width: int):
@@ -221,6 +222,8 @@ def _tf_consts(tf) -> tuple:
     every production path closes over a concrete TF (the session rebuilds
     its compiled steps on a runtime TF swap), and a traced TF would need
     the knots as kernel operands — use fold="pallas_seg" there."""
+    # only the tracer-leak family is "the TF is traced"; anything else
+    # (renamed field, numpy failure) is a genuine bug and must propagate
     try:
         ax = np.asarray(tf.alpha_x).tolist()
         am = np.asarray(tf.alpha_m).tolist()
@@ -228,7 +231,8 @@ def _tf_consts(tf) -> tuple:
         cx = np.asarray(tf.color_x).tolist()
         cm = np.asarray(tf.color_m).tolist()
         cb = np.asarray(tf.color_b).tolist()
-    except Exception as e:
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError) as e:
         raise ValueError(
             "the fused fold schedules (pallas_fused / fused_stream) bake "
             "the transfer function into the kernel and need a CONCRETE "

@@ -165,10 +165,21 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
         # write fold (pallas_seg.seg_fold_chunk) and the counting kernel
         # the histogram/temporal-seed march uses (pm.count_multi_chunk) —
         # a spec whose write kernel compiles but whose counting kernel is
-        # rejected would still fail inside initial_threshold()
+        # rejected would still fail inside initial_threshold(). EVERY
+        # kernel GEOMETRY must pass too: the occupancy-skip branch of
+        # slice_march feeds a 1-slice chunk (slicer.skip), compiling a
+        # second c=1 variant of each kernel inside the traced step, so
+        # probe that geometry alongside cfg.chunk (cheap, cached) — but
+        # only when the skip path is reachable (skip_empty): with
+        # skipping off the c=1 kernels are never built, and a c=1
+        # rejection must not demote a config that would never trace it.
         if jax.default_backend() == "tpu":
+            c1_ok = (not cfg.skip_empty
+                     or (psg.seg_compile_ok(32, 1, ni)
+                         and pm.count_compile_ok(32, 1, ni)))
             fold = ("pallas_seg" if psg.seg_compile_ok(32, cfg.chunk, ni)
-                    and pm.count_compile_ok(32, cfg.chunk, ni) else "seg")
+                    and pm.count_compile_ok(32, cfg.chunk, ni)
+                    and c1_ok else "seg")
         else:
             fold = "xla"
     if fold not in ("xla", "pallas", "seg", "pallas_seg", "pallas_fused",

@@ -1,2 +1,3 @@
 from scenery_insitu_tpu.ingest.shm import (  # noqa: F401
-    ShmConsumer, ShmProducer, ShmVolumeSource, ensure_built)
+    ShmConsumer, ShmProducer, ShmShardedVolumeSource, ShmVolumeSource,
+    ensure_built)

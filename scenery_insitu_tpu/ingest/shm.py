@@ -248,6 +248,140 @@ class ShmConsumer:
             self.handle = None
 
 
+class ShmShardedVolumeSource:
+    """Multi-rank external feed for the DISTRIBUTED pipeline: one shm
+    channel per compute rank (z-slab order), assembled into one
+    mesh-sharded global ``jax.Array`` — each slab is ``device_put`` onto
+    its own mesh device and stitched with
+    ``make_array_from_single_device_arrays``, so no global host-side
+    copy ever exists and the session's ``shard_volume`` re-placement is
+    a no-op (the array is already committed with the pipeline's
+    sharding). This is the operator boundary the reference crossed with
+    per-rank MPI partners each updating their renderer's slab
+    (DistributedVolumeRenderer.kt:136-160); here N external producer
+    processes feed an InSituSession over a ``Mesh`` exactly like the
+    built-in sharded sims.
+
+    ``coherent=True`` (default) additionally requires the per-rank
+    sequence numbers of one assembled frame to MATCH — the renderer
+    never mixes simulation timesteps across slabs (the reference renders
+    whatever each rank last delivered; pass ``coherent=False`` for that
+    semantics). Coherence matching assumes lockstep producers (each
+    publish succeeds: the ring overwrites, it never drops without
+    pinned readers). Before the FIRST frame set is assembled a timeout
+    raises, naming the per-rank seqs so a desync is diagnosable; after
+    that ``advance`` paces to the producers — it blocks up to
+    ``frame_timeout_ms`` for a strictly newer set, then keeps rendering
+    the last one (the single-channel source's semantics).
+
+    ``timeout_ms`` bounds channel appearance + the first frame set;
+    ``frame_timeout_ms`` (default: ``timeout_ms``) bounds each
+    subsequent wait for a newer set.
+    """
+
+    def __init__(self, channels: Sequence[str], slab_shape: Sequence[int],
+                 mesh, axis_name: Optional[str] = None,
+                 timeout_ms: int = 10000, coherent: bool = True,
+                 poll_interval_ms: int = 5,
+                 frame_timeout_ms: Optional[int] = None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.kind = "external"
+        axis = axis_name or mesh.axis_names[0]
+        n = mesh.shape[axis]
+        if len(channels) != n:
+            raise ValueError(f"{len(channels)} channels for a mesh of "
+                             f"{n} devices along {axis!r} — need one "
+                             "channel per rank, z order")
+        self.channels = list(channels)
+        self.slab_shape = tuple(slab_shape)
+        dn = self.slab_shape[0]
+        self.global_shape = (dn * n,) + self.slab_shape[1:]
+        self.timeout_ms = timeout_ms
+        self.frame_timeout_ms = (timeout_ms if frame_timeout_ms is None
+                                 else frame_timeout_ms)
+        self.coherent = coherent
+        self.poll_interval_ms = poll_interval_ms
+        self._jax = jax
+        self.sharding = NamedSharding(mesh, P(axis, None, None))
+        # mesh device of each rank's shard, in z order (shard r owns
+        # global rows [r*dn, (r+1)*dn))
+        dmap = self.sharding.addressable_devices_indices_map(
+            self.global_shape)
+        by_rank = {}
+        for dev, idx in dmap.items():
+            by_rank[(idx[0].start or 0) // dn] = dev
+        self._devices = [by_rank[r] for r in range(n)]
+        self.consumers = [ShmConsumer(c, self.slab_shape,
+                                      timeout_ms=timeout_ms)
+                          for c in channels]
+        self._held = [None] * n        # newest (frame, seq) seen per rank
+        self._field = None
+        self.last_seqs: Tuple[int, ...] = ()
+
+    def _refresh(self, wait_ms: int) -> None:
+        for r, con in enumerate(self.consumers):
+            got = con.latest(timeout_ms=wait_ms)
+            if got is not None:
+                self._held[r] = got
+
+    def _aligned(self) -> bool:
+        if any(h is None for h in self._held):
+            return False
+        if not self.coherent:
+            return True
+        seqs = {h[1] for h in self._held}
+        return len(seqs) == 1
+
+    def advance(self, n: int = 1) -> None:   # n meaningless for external
+        import time
+        wait_ms = (self.timeout_ms if self._field is None
+                   else self.frame_timeout_ms)
+        deadline = time.monotonic() + wait_ms / 1000.0
+        first = True
+        while True:
+            # first pass is free (producers may have already published);
+            # later passes wait a poll interval inside the consumer
+            self._refresh(0 if first else self.poll_interval_ms)
+            first = False
+            # only a STRICTLY NEWER aligned set completes the wait —
+            # otherwise a fast render loop would busy-spin re-rendering
+            # the same frame instead of pacing to the producers
+            if self._aligned():
+                seqs = tuple(h[1] for h in self._held)
+                if seqs != self.last_seqs:
+                    arrs = [self._jax.device_put(h[0], d)
+                            for h, d in zip(self._held, self._devices)]
+                    self._field = \
+                        self._jax.make_array_from_single_device_arrays(
+                            self.global_shape, self.sharding, arrs)
+                    self.last_seqs = seqs
+                    return
+            if time.monotonic() > deadline:
+                if self._field is not None:
+                    return                     # keep rendering last frame
+                held = [None if h is None else h[1] for h in self._held]
+                raise TimeoutError(
+                    f"no {'coherent ' if self.coherent else ''}frame set "
+                    f"from {self.channels} within {wait_ms} ms "
+                    f"(per-rank seqs: {held})")
+
+    @property
+    def field(self):
+        if self._field is None:
+            self.advance(1)
+        return self._field
+
+    def stats(self) -> list:
+        """Per-rank channel control blocks (seq/drop/reader state)."""
+        return [channel_stats(c) for c in self.channels]
+
+    def close(self) -> None:
+        for con in self.consumers:
+            con.close()
+
+
 class ShmVolumeSource:
     """Session sim-adapter over a shm channel: ``advance(n)`` pulls the
     newest frame (blocking until one arrives), ``.field`` is the device

@@ -195,6 +195,108 @@ def test_session_driven_by_external_cpp_sim():
         proc.wait(timeout=10)
 
 
+def _run_slab_producers(n: int, d: int, frames: int):
+    """Run n slab producers to completion (one per rank) + one whole-field
+    producer of the same deterministic Gaussian; returns (slab_channels,
+    whole_channel). Exited producers leave their final frame in the ring,
+    so consumers see one static, bit-identical frame set — parity between
+    the multi-rank and whole-field feeds is then exact, not statistical."""
+    ensure_built()
+    chans = [_chan() for _ in range(n)]
+    whole = _chan()
+    procs = [subprocess.Popen(
+        [DEMO_PRODUCER, c, "slab", str(d), str(frames), "0", str(r), str(n)],
+        stdout=subprocess.DEVNULL) for r, c in enumerate(chans)]
+    procs.append(subprocess.Popen(
+        [DEMO_PRODUCER, whole, "field", str(d), str(frames), "0"],
+        stdout=subprocess.DEVNULL))
+    for p in procs:
+        assert p.wait(timeout=30) == 0
+    return chans, whole
+
+
+def test_sharded_source_assembles_coherent_global_field():
+    """N external slab producers -> ONE mesh-sharded global jax.Array:
+    values bit-equal to the whole-field producer's frame, shards placed
+    one-per-device with the distributed pipeline's sharding (so the
+    session's shard_volume re-placement is a no-op)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from scenery_insitu_tpu.ingest.shm import ShmShardedVolumeSource
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+
+    n, d = 2, 16
+    chans, whole = _run_slab_producers(n, d, frames=3)
+    mesh = make_mesh(n)
+    src = ShmShardedVolumeSource(chans, (d // n, d, d), mesh,
+                                 timeout_ms=5000, frame_timeout_ms=300)
+    try:
+        field = src.field
+        assert field.shape == (d, d, d)
+        assert len(set(src.last_seqs)) == 1          # coherent frame set
+        assert field.sharding.is_equivalent_to(
+            NamedSharding(mesh, P(mesh.axis_names[0], None, None)),
+            field.ndim)
+        shards = {s.device: s.data.shape for s in field.addressable_shards}
+        assert len(shards) == n
+        assert set(shards.values()) == {(d // n, d, d)}
+        ref = ShmConsumer(whole, (d, d, d), timeout_ms=5000)
+        want, _ = ref.latest(timeout_ms=2000)
+        ref.close()
+        assert np.array_equal(np.asarray(field), want)
+        # advance with exited producers keeps the last coherent frame
+        src.advance(1)
+        assert src.last_seqs and np.asarray(src.field).max() > 0.5
+    finally:
+        src.close()
+        from scenery_insitu_tpu.ingest.shm import unlink
+        for c in chans + [whole]:
+            unlink(c)
+
+
+def test_session_driven_by_multirank_external_producers():
+    """The last operator-boundary gap (round-4 VERDICT item 5): N
+    demo_producer processes, one per rank slab, feed the DISTRIBUTED
+    pipeline through an InSituSession over the virtual mesh — and the
+    render equals the same session fed the whole field through one
+    channel (≅ DistributedVolumeRenderer.kt:136-160's per-rank MPI
+    partners vs a single-source run)."""
+    from scenery_insitu_tpu.config import FrameworkConfig
+    from scenery_insitu_tpu.ingest.shm import (ShmShardedVolumeSource,
+                                               unlink)
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.runtime.session import InSituSession
+
+    n, d = 4, 16
+    chans, whole = _run_slab_producers(n, d, frames=3)
+    mesh = make_mesh(n)
+    cfg = FrameworkConfig().with_overrides(
+        "render.width=32", "render.height=24", "render.max_steps=16",
+        "vdi.max_supersegments=4", "vdi.adaptive_iters=1",
+        "composite.max_output_supersegments=4",
+        "composite.adaptive_iters=1", "sim.steps_per_frame=1",
+        "runtime.dataset=procedural")
+    src_multi = ShmShardedVolumeSource(chans, (d // n, d, d), mesh,
+                                       timeout_ms=5000,
+                                       frame_timeout_ms=300)
+    # channels already exist (producers ran to completion), so the short
+    # timeout only bounds the keep-last-frame wait per advance
+    src_single = ShmVolumeSource(whole, (d, d, d), timeout_ms=1500)
+    try:
+        pay_m = InSituSession(cfg, mesh=mesh, sim=src_multi).run(2)
+        pay_s = InSituSession(cfg, mesh=mesh, sim=src_single).run(2)
+        assert pay_m["vdi_color"].max() > 0.0        # blob visible
+        np.testing.assert_array_equal(pay_m["vdi_color"],
+                                      pay_s["vdi_color"])
+        np.testing.assert_array_equal(pay_m["vdi_depth"],
+                                      pay_s["vdi_depth"])
+    finally:
+        src_multi.close()
+        src_single.consumer.close()
+        for c in chans + [whole]:
+            unlink(c)
+
+
 def test_concurrent_stress_no_torn_frames():
     """Race stress (the reference ships NO race detection — SURVEY §5):
     one producer process-thread publishing checksummed frames as fast as

@@ -1,7 +1,8 @@
 """VDI compression benchmark (≅ reference VDICompressionBenchmarks.kt:
 LZ4 / Snappy / LZMA / Gzip over stored VDI color+depth buffers with verify
-+ timed iterations, :226-309). Codecs here are the ones this environment
-ships: zstd (the fast-codec role), zlib, lzma.
++ timed iterations, :226-309). Codecs: the vendored native LZ4 block
+codec (ingest/native/lz4_block.cpp — the reference's actual wire-codec
+family), zstd, zlib, lzma.
 
 Usage: python benchmarks/compression_bench.py [--size 720p] [--k 16]
        [--iters 20] [--grid 64]
@@ -75,8 +76,13 @@ def main():
     color, depth = make_vdi(args.width, args.height, args.k, args.grid)
     print(f"VDI {args.width}x{args.height} K={args.k}: color {color.nbytes} B"
           f" + depth {depth.nbytes} B")
-    for name, level in [("zstd", 1), ("zstd", 3), ("zstd", 9),
-                        ("zlib", 1), ("zlib", 6), ("lzma", 0), ("none", 0)]:
+    codecs = [("lz4", -1), ("zstd", 1), ("zstd", 3), ("zstd", 9),
+              ("zlib", 1), ("zlib", 6), ("lzma", 0), ("none", 0)]
+    from scenery_insitu_tpu.io import lz4 as _lz4
+    if not _lz4.available():
+        print("  (lz4: native build unavailable, skipped)")
+        codecs = [(c, l) for c, l in codecs if c != "lz4"]
+    for name, level in codecs:
         bench_codec(name, level, [color, depth], args.iters)
 
 

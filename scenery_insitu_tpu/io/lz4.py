@@ -1,0 +1,88 @@
+"""ctypes binding for the native LZ4 block codec (ingest/native/
+lz4_block.cpp) — the fast-codec point of the reference's VDI wire path
+(VDICompositingTest.kt:251-304, VDICompressionBenchmarks.kt:23-372)
+that zstd cannot reach: LZ4's decode is a near-memcpy, which is what a
+per-frame decompress-on-receive hop wants.
+
+Blob layout: 8-byte little-endian uncompressed size, then the raw LZ4
+block stream (the block format itself does not carry the size; the
+reference sent per-segment byte counts alongside for the same reason).
+Empty payloads are the 8-byte header alone.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ingest", "native", "build",
+    "liblz4block.so")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        from scenery_insitu_tpu.ingest.shm import ensure_built
+
+        ensure_built()                      # same Makefile builds the codec
+        lib = ctypes.CDLL(_LIB_PATH)
+        for name in ("lz4b_compress", "lz4b_decompress"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                           ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+        lib.lz4b_bound.restype = ctypes.c_uint64
+        lib.lz4b_bound.argtypes = [ctypes.c_uint64]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """Can the native codec be built/loaded here? (Needs g++.)"""
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def compress(data: bytes) -> bytes:
+    lib = _load()
+    n = len(data)
+    header = n.to_bytes(8, "little")
+    if n == 0:
+        return header
+    cap = int(lib.lz4b_bound(n))
+    out = (ctypes.c_uint8 * cap)()
+    written = lib.lz4b_compress(data, n, out, cap)
+    if written == 0:
+        raise OSError(f"lz4 compression failed for {n}-byte payload")
+    return header + ctypes.string_at(out, written)
+
+
+def decompress(blob: bytes) -> bytes:
+    lib = _load()
+    if len(blob) < 8:
+        raise ValueError("lz4 blob shorter than its size header")
+    n = int.from_bytes(blob[:8], "little")
+    if n == 0:
+        if len(blob) != 8:
+            raise ValueError("empty lz4 payload with trailing bytes")
+        return b""
+    # the header is untrusted wire data: bound the allocation by the
+    # format's maximum expansion (~255x per match-run byte) before
+    # committing n bytes — the native decoder's own checks run after
+    if n > (len(blob) - 8) * 255 + 16:
+        raise ValueError(
+            f"corrupt lz4 blob: header claims {n} bytes from "
+            f"{len(blob) - 8} compressed — exceeds format max expansion")
+    out = (ctypes.c_uint8 * n)()
+    got = lib.lz4b_decompress(blob[8:], len(blob) - 8, out, n)
+    if got != n:
+        raise ValueError(
+            f"corrupt lz4 blob: header says {n} bytes, decoder produced "
+            f"{got}")
+    return ctypes.string_at(out, n)

@@ -1,0 +1,148 @@
+"""Native LZ4 block codec tests (ingest/native/lz4_block.cpp): byte-level
+round trips, FORMAT CONFORMANCE against an independent pure-Python block
+decoder written straight from the public spec (so the C++ compressor's
+streams are pinned to the format, not merely to its own decompressor),
+corrupt-input rejection, and the VDI wire path with codec="lz4"
+(≅ reference VDICompositingTest.kt:251-304 compressing per-rank segments,
+VDICompressionBenchmarks.kt:23-372)."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    subprocess.run(["which", "g++"], capture_output=True).returncode != 0,
+    reason="no C++ toolchain")
+
+from scenery_insitu_tpu.io import lz4
+
+
+def ref_decode_block(buf: bytes) -> bytes:
+    """Independent LZ4 block decoder, transcribed from the public format
+    description: [token][lit-run][literals][offset LE16][match-run]...,
+    255-continuation lengths, minmatch 4, last sequence literal-only."""
+    out = bytearray()
+    i = 0
+    n = len(buf)
+    while i < n:
+        token = buf[i]
+        i += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                b = buf[i]
+                i += 1
+                lit += b
+                if b != 255:
+                    break
+        out += buf[i:i + lit]
+        i += lit
+        if i >= n:
+            break
+        off = buf[i] | (buf[i + 1] << 8)
+        i += 2
+        assert 0 < off <= len(out), "offset outside decoded prefix"
+        mlen = token & 15
+        if mlen == 15:
+            while True:
+                b = buf[i]
+                i += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        for _ in range(mlen):          # byte-wise: overlap semantics
+            out.append(out[-off])
+    return bytes(out)
+
+
+PAYLOADS = {
+    "zeros": b"\x00" * 4096,
+    "text": b"the quick brown fox jumps over the lazy dog " * 64,
+    "random": np.random.default_rng(3).bytes(4096),
+    "sparse_f32": np.where(
+        np.random.default_rng(4).random(4096) > 0.9,
+        np.random.default_rng(5).random(4096), 0.0
+    ).astype(np.float32).tobytes(),
+    "tiny": b"ab",
+    "empty": b"",
+}
+
+
+@pytest.mark.parametrize("name", sorted(PAYLOADS))
+def test_roundtrip(name):
+    data = PAYLOADS[name]
+    assert lz4.decompress(lz4.compress(data)) == data
+
+
+@pytest.mark.parametrize("name", sorted(PAYLOADS))
+def test_conformance_against_independent_decoder(name):
+    """C++-compressed stream decoded by the spec-transcribed Python
+    decoder — any conformant LZ4 block decoder must accept our output."""
+    data = PAYLOADS[name]
+    blob = lz4.compress(data)
+    n = int.from_bytes(blob[:8], "little")
+    assert n == len(data)
+    assert ref_decode_block(blob[8:]) == data
+
+
+def test_sizes_sweep():
+    rng = np.random.default_rng(0)
+    for size in (1, 3, 12, 13, 15, 16, 64, 255, 256, 1000, 65535, 65536,
+                 200_000):
+        base = rng.bytes(max(1, size // 17))
+        data = (base * (size // len(base) + 1))[:size]
+        assert lz4.decompress(lz4.compress(data)) == data, size
+
+
+def test_window_limit_respected():
+    """A repeat farther than 65535 bytes must be emitted as literals
+    (offsets are 16-bit) — output still round-trips AND conforms."""
+    rng = np.random.default_rng(1)
+    marker = b"ABCDEFGHIJKLMNOP" * 4
+    data = marker + rng.bytes(70_000) + marker
+    blob = lz4.compress(data)
+    assert lz4.decompress(blob) == data
+    assert ref_decode_block(blob[8:]) == data
+
+
+def test_truncated_blob_rejected():
+    blob = lz4.compress(b"hello world " * 100)
+    with pytest.raises(ValueError):
+        lz4.decompress(blob[:len(blob) // 2])
+    with pytest.raises(ValueError):
+        lz4.decompress(blob[:5])           # shorter than the size header
+
+
+def test_oversized_header_rejected_before_allocation():
+    """An untrusted wire header claiming gigabytes must be rejected by
+    the expansion bound, not by attempting the allocation."""
+    evil = (1 << 40).to_bytes(8, "little") + b"\x00" * 16
+    with pytest.raises(ValueError, match="max expansion"):
+        lz4.decompress(evil)
+
+
+def test_compresses_real_vdi_payload():
+    data = np.where(np.random.default_rng(2).random((8, 4, 64, 64)) > 0.85,
+                    1.0, 0.0).astype(np.float32).tobytes()
+    blob = lz4.compress(data)
+    assert len(blob) < len(data) // 3      # sparse VDI planes compress
+
+
+def test_vdi_segment_wire_path():
+    from scenery_insitu_tpu.core.vdi import VDI
+    from scenery_insitu_tpu.io.vdi_io import (pack_vdi_segments,
+                                              unpack_vdi_segments)
+
+    k, h, w = 4, 16, 32
+    rng = np.random.default_rng(6)
+    color = np.where(rng.random((k, 4, h, w)) > 0.8,
+                     rng.random((k, 4, h, w)), 0.0).astype(np.float32)
+    depth = np.sort(rng.random((k, 2, h, w)).astype(np.float32), axis=1)
+    vdi = VDI(color, depth)
+    blobs, climits, dlimits = pack_vdi_segments(vdi, 4, codec="lz4")
+    assert list(climits) + list(dlimits) == [len(b) for b in blobs]
+    out = unpack_vdi_segments(blobs, k, h, w, codec="lz4")
+    np.testing.assert_array_equal(np.asarray(out.color), color)
+    np.testing.assert_array_equal(np.asarray(out.depth), depth)

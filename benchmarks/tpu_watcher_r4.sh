@@ -143,6 +143,17 @@ run_step() {  # run_step <n>
     18) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
          SITPU_BENCH_FOLD=fused_stream SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 19 (round 5): flagship with the RENDER copy in bf16 — if the march
+    # is HBM-bound (the roofline fields now in bench.py decide), halving
+    # the marched volume's bytes is the single biggest lever; the
+    # resampling matmuls already cast to bf16 so MXU work is unchanged
+    19) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
+         SITPU_BENCH_RENDER_DTYPE=bf16 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 20 (round 5): novel-view error study on hardware (exact renderer +
+    # proxy PSNR sweep — the docs table's TPU twin)
+    20) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
+         SITPU_BENCH_REAL=1 python benchmarks/novel_view_study.py ;;
   esac
 }
 
@@ -166,10 +177,12 @@ step_out() {
     16) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
     17) echo "$R/bench_tpu_r4_512_fused.json" ;;
     18) echo "$R/bench_tpu_r4_512_fstream.json" ;;
+    19) echo "$R/bench_tpu_r5_512_bf16.json" ;;
+    20) echo "$R/novel_view_study_tpu_r5.json" ;;
   esac
 }
 
-NSTEPS=18
+NSTEPS=20
 MAXFAIL=2
 for i in $(seq 1 500); do
   next=""

@@ -418,11 +418,13 @@ def _open_video_writer(path: str, fps: float, size: Tuple[int, int]):
     DistributedVolumeRenderer.kt:275-291 VideoEncoder → UDP:3337). Probes
     avc1/H264 and falls back to mp4v. This image's cv2 carries no
     libx264/openh264 and no ffmpeg/PyAV exists either (checked 2026-07-31),
-    so mp4v is the expected outcome here — the transport/movie role is
-    covered, H264 bitstream compatibility is an explicit environment gap
-    (see README "Known gaps"). A failed probe may print cv2/ffmpeg codec
-    errors to stderr once (native-layer prints, not exceptions); the
-    fallback proceeds regardless. Returns (writer, fourcc_used)."""
+    so mp4v is the expected outcome for THIS cv2 path; a guaranteed real
+    H264 bitstream is available regardless via the vendored I_PCM writer
+    (`io/h264.py`, ``video_sink(..., codec="h264_ipcm")``) — conformance
+    pinned by decoding through cv2's H264 decoder in tests/test_h264.py.
+    A failed probe may print cv2/ffmpeg codec errors to stderr once
+    (native-layer prints, not exceptions); the fallback proceeds
+    regardless. Returns (writer, fourcc_used)."""
     import cv2
 
     for cc in ("avc1", "H264"):
@@ -437,13 +439,35 @@ def _open_video_writer(path: str, fps: float, size: Tuple[int, int]):
                             size), "mp4v")
 
 
-def video_sink(path: str, fps: float = 30.0, gamma: float = 2.2
-               ) -> Callable[[int, dict], None]:
+def video_sink(path: str, fps: float = 30.0, gamma: float = 2.2,
+               codec: str = "auto") -> Callable[[int, dict], None]:
     """Movie-writer sink for session image payloads (≅ the reference's
     VideoEncoder movie file, DistributedVolumeRenderer.kt:285). Lazily opens
     the writer on the first frame (size unknown until then); the codec
-    actually used is exposed as ``sink.codec`` after that (H264 when the
-    cv2 build has an encoder, else mp4v — see `_open_video_writer`)."""
+    actually used is exposed as ``sink.codec`` after that.
+
+    ``codec="auto"`` (default): cv2 writer, H264 when the build has an
+    encoder, else mp4v (`_open_video_writer`). ``codec="h264_ipcm"``:
+    the vendored always-available REAL H264 elementary stream
+    (io/h264.h264_sink — all-intra I_PCM, lossless in YUV, large files;
+    give ``path`` an .h264 extension so players treat it as an
+    elementary stream)."""
+    if codec == "h264_ipcm":
+        from scenery_insitu_tpu.io.h264 import h264_sink
+
+        inner = h264_sink(path, gamma=gamma, fps=fps)
+
+        def sink(index: int, payload: dict) -> None:
+            img = _payload_image(payload)
+            if img is not None:
+                inner(img)
+
+        sink.codec = inner.codec
+        sink.release = inner.close
+        return sink
+    if codec != "auto":
+        raise ValueError(f"unknown video codec {codec!r} "
+                         "(expected 'auto' or 'h264_ipcm')")
     state = {"writer": None}
 
     def sink(index: int, payload: dict) -> None:

@@ -1,0 +1,192 @@
+#!/bin/bash
+# Round-5 watcher. Same resumable skeleton as tpu_watcher_r4.sh (probe
+# before EVERY step, output file = done marker, fail counter after
+# MAXFAIL tunnel-alive failures) with the queue REORDERED for what the
+# first round-5 window measured: the tunnel comes up for ~4-minute
+# windows, which is enough for one flagship bench.py run (~60 s
+# compile+25 frames) but not for the 10-variant fold microbench (step 2
+# of the r4 queue hung mid-compile when the window closed). So the
+# short, one-compile flagship A/Bs lead — each IS a full-scale fold
+# schedule datapoint — and the compile-heavy sweeps (split in two),
+# profiles and the 1024^3 attempt follow. Artifact names are unchanged
+# from the r4 queue where the step is unchanged, so done markers carry.
+# Log: /tmp/tpu_watcher_r5.log
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p benchmarks/results
+R=benchmarks/results
+L=/tmp/tpu_watcher_r5.log
+LAYOUT=r5v1
+if [ "$(cat /tmp/r5_layout 2>/dev/null)" != "$LAYOUT" ]; then
+  rm -f /tmp/r5_fail.*
+  echo "$LAYOUT" > /tmp/r5_layout
+fi
+
+probe() {
+  timeout 120 python - <<'EOF' 2>/dev/null
+import jax
+assert jax.devices()[0].platform == "tpu"
+import jax.numpy as jnp
+assert float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()) > 0
+EOF
+}
+
+run_json() {
+  local out="$1" tmo="$2"; shift 2
+  if timeout "$tmo" "$@" > "$out.full.tmp" 2>>"$L" \
+     && tail -1 "$out.full.tmp" > "$out.tmp" \
+     && python -c "import json,sys; json.load(open(sys.argv[1]))" \
+          "$out.tmp" 2>>"$L"; then
+    mv "$out.tmp" "$out"; rm -f "$out.full.tmp" "$out.failed"
+    echo "ok: $out $(date -u +%H:%M:%S)" >> "$L"
+    cat "$out"
+  else
+    if [ -s "$out.full.tmp" ]; then mv "$out.full.tmp" "$out.failed"; fi
+    rm -f "$out.tmp" "$out.full.tmp"
+    echo "FAILED: $out $(date -u +%H:%M:%S)" >> "$L"
+  fi
+}
+
+run_jsonl() {
+  local out="$1" tmo="$2"; shift 2
+  if timeout "$tmo" "$@" > "$out.tmp" 2>>"$L"; then
+    mv "$out.tmp" "$out"; echo "ok: $out $(date -u +%H:%M:%S)" >> "$L"
+    cat "$out"
+  else
+    if [ -s "$out.tmp" ]; then mv "$out.tmp" "$out.partial"; fi
+    rm -f "$out.tmp"; echo "FAILED: $out $(date -u +%H:%M:%S)" >> "$L"
+  fi
+}
+
+run_step() {  # run_step <n>
+  case "$1" in
+    # ---- short flagship A/Bs first: one compile + 25 frames each ----
+    # 1: flagship 512^3, default fold (done in window 1: 2.38 fps)
+    1) run_json "$R/bench_tpu_r4_512.json" 1000 env \
+         SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
+         python bench.py ;;
+    # 2: fused shade+fold kernel (rgba/depth streams never hit HBM)
+    2) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
+         SITPU_BENCH_FOLD=pallas_fused SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 3: whole-march stream fold ([K] state crosses HBM once per march)
+    3) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
+         SITPU_BENCH_FOLD=fused_stream SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 4: pure-XLA seg fold (Mosaic-free A/B)
+    4) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 5: bf16 RENDER copy — the HBM-traffic lever (matmuls already bf16)
+    5) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
+         SITPU_BENCH_RENDER_DTYPE=bf16 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 6: in-plane occupancy v-tiles
+    6) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
+         SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 7: 256^3 exact round-2 config A/B (the regression attribution)
+    7) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
+         SITPU_BENCH_GRID=256 SITPU_BENCH_ADAPTIVE_MODE=histogram \
+         SITPU_BENCH_FOLD=xla SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 8: 256^3 round-default (temporal + seg fold)
+    8) run_json "$R/bench_tpu_r4_256.json" 900 env \
+         SITPU_BENCH_GRID=256 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 9: flagship at chunk 32
+    9) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
+         SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # ---- medium steps: profiles and split microbench sweeps ----
+    # 10: march-stage profile at 512 (where do the ms go?)
+    10) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
+         python -u benchmarks/profile_march.py 512 ;;
+    # 11: fold microbench, core schedules (floors + seg family)
+    11) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --variants none,count,xla,seg,pallas_seg ;;
+    # 12: fold microbench, fused family (+ its controlled baselines)
+    12) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --variants pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
+    # 13: the 1024^3 north-star attempt (diagnosed OOM is also a result)
+    13) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
+         SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
+         python bench.py ;;
+    # ---- the rest of the r4 queue ----
+    14) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
+         python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
+         --variants none,count,xla,seg,pallas_seg,pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
+    15) run_json "$R/novel_view_tpu_r4.json" 1500 \
+         python benchmarks/novel_view_bench.py --iters 3 ;;
+    16) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
+         python benchmarks/composite_bench.py ;;
+    17) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
+         python benchmarks/scaling_bench.py --grid 128 --frames 10 ;;
+    18) run_json "$R/profile_frame_tpu_r4.json" 1200 \
+         python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
+    19) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --chunk 32 --variants xla,seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
+    20) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
+         python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
+         --chunk 64 --variants seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
+    21) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
+         SITPU_BENCH_REAL=1 python benchmarks/novel_view_study.py ;;
+  esac
+}
+
+step_out() {
+  case "$1" in
+    1) echo "$R/bench_tpu_r4_512.json" ;;
+    2) echo "$R/bench_tpu_r4_512_fused.json" ;;
+    3) echo "$R/bench_tpu_r4_512_fstream.json" ;;
+    4) echo "$R/bench_tpu_r4_512_segxla.json" ;;
+    5) echo "$R/bench_tpu_r5_512_bf16.json" ;;
+    6) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
+    7) echo "$R/bench_tpu_r4_256_r2config.json" ;;
+    8) echo "$R/bench_tpu_r4_256.json" ;;
+    9) echo "$R/bench_tpu_r4_512_c32.json" ;;
+    10) echo "$R/profile_march_512_r4.txt" ;;
+    11) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
+    12) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
+    13) echo "$R/bench_tpu_r4_1024.json" ;;
+    14) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
+    15) echo "$R/novel_view_tpu_r4.json" ;;
+    16) echo "$R/composite_tpu_r4.json" ;;
+    17) echo "$R/scaling_tpu_r4.json" ;;
+    18) echo "$R/profile_frame_tpu_r4.json" ;;
+    19) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
+    20) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
+    21) echo "$R/novel_view_study_tpu_r5.json" ;;
+  esac
+}
+
+NSTEPS=21
+MAXFAIL=2
+for i in $(seq 1 900); do
+  next=""
+  for s in $(seq 1 $NSTEPS); do
+    fails=$(cat "/tmp/r5_fail.$s" 2>/dev/null || echo 0)
+    [ -e "$(step_out "$s")" ] || [ "$fails" -ge $MAXFAIL ] \
+      || { next="$s"; break; }
+  done
+  [ -z "$next" ] && { echo "suite done $(date -u)" >> "$L"; exit 0; }
+  if probe; then
+    echo "tunnel alive $(date -u +%H:%M:%S), step $next" | tee -a "$L"
+    date -u >> "$R/tpu_alive_r4.marker"
+    run_step "$next"
+    if [ -e "$(step_out "$next")" ]; then
+      rm -f "/tmp/r5_fail.$next"
+    elif probe; then
+      fails=$(cat "/tmp/r5_fail.$next" 2>/dev/null || echo 0)
+      echo $((fails + 1)) > "/tmp/r5_fail.$next"
+      echo "fail $((fails + 1))/$MAXFAIL for step $next (tunnel alive)" \
+        >> "$L"
+    fi
+  else
+    echo "tunnel dead $(date -u +%H:%M:%S), step $next pending" >> "$L"
+    sleep 45
+  fi
+done

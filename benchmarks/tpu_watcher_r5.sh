@@ -16,7 +16,7 @@ cd "$(dirname "$0")/.." || exit 1
 mkdir -p benchmarks/results
 R=benchmarks/results
 L=/tmp/tpu_watcher_r5.log
-LAYOUT=r5v2
+LAYOUT=r5v3
 if [ "$(cat /tmp/r5_layout 2>/dev/null)" != "$LAYOUT" ]; then
   rm -f /tmp/r5_fail.*
   echo "$LAYOUT" > /tmp/r5_layout
@@ -72,75 +72,81 @@ run_step() {  # run_step <n>
     # case every schedule A/B will come back flat, as rounds 3-5 did)
     2) run_json "$R/hbm_micro_tpu_r5.json" 600 \
          python benchmarks/hbm_bench.py ;;
-    # 3: fused shade+fold kernel (rgba/depth streams never hit HBM)
-    3) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
+    # 3: RENDER-ONLY flagship (sim_steps=0, static field, moving camera
+    # — the reference's own FPS-harness semantics, and the honest
+    # in-situ split: its sim runs on CPU nodes while the GPU renders)
+    3) run_json "$R/bench_tpu_r5_512_render.json" 900 env \
+         SITPU_BENCH_SIM_STEPS=0 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # 4: fused shade+fold kernel (rgba/depth streams never hit HBM)
+    4) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
          SITPU_BENCH_FOLD=pallas_fused SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 4: whole-march stream fold ([K] state crosses HBM once per march)
-    4) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
+    5) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
          SITPU_BENCH_FOLD=fused_stream SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 5: pure-XLA seg fold (Mosaic-free A/B)
-    5) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
+    6) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 6: bf16 RENDER copy — the HBM-traffic lever (matmuls already bf16)
-    6) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
+    7) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
          SITPU_BENCH_RENDER_DTYPE=bf16 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 7: in-plane occupancy v-tiles
-    7) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
+    8) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
          SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 8: 256^3 exact round-2 config A/B (the regression attribution)
-    8) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
+    9) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
          SITPU_BENCH_GRID=256 SITPU_BENCH_ADAPTIVE_MODE=histogram \
          SITPU_BENCH_FOLD=xla SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 9: 256^3 round-default (temporal + seg fold)
-    9) run_json "$R/bench_tpu_r4_256.json" 900 env \
+    10) run_json "$R/bench_tpu_r4_256.json" 900 env \
          SITPU_BENCH_GRID=256 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 10: flagship at chunk 32
-    10) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
+    11) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
          SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # ---- medium steps: profiles and split microbench sweeps ----
     # 11: march-stage profile at 512 (where do the ms go?)
-    11) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
+    12) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
          python -u benchmarks/profile_march.py 512 ;;
     # 12: fold microbench, core schedules (floors + seg family)
-    12) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
+    13) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants none,count,xla,seg,pallas_seg ;;
     # 13: fold microbench, fused family (+ its controlled baselines)
-    13) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
+    14) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
     # 14: the 1024^3 north-star attempt (diagnosed OOM is also a result)
-    14) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
+    15) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
          SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
          python bench.py ;;
     # ---- the rest of the r4 queue ----
-    15) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
+    16) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
          --variants none,count,xla,seg,pallas_seg,pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
-    16) run_json "$R/novel_view_tpu_r4.json" 1500 \
+    17) run_json "$R/novel_view_tpu_r4.json" 1500 \
          python benchmarks/novel_view_bench.py --iters 3 ;;
-    17) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
+    18) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
          python benchmarks/composite_bench.py ;;
-    18) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
+    19) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
          python benchmarks/scaling_bench.py --grid 128 --frames 10 ;;
-    19) run_json "$R/profile_frame_tpu_r4.json" 1200 \
+    20) run_json "$R/profile_frame_tpu_r4.json" 1200 \
          python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
-    20) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
+    21) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 32 --variants xla,seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    21) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
+    22) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 64 --variants seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    22) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
+    23) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
          SITPU_BENCH_REAL=1 python benchmarks/novel_view_study.py ;;
   esac
 }
@@ -149,30 +155,31 @@ step_out() {
   case "$1" in
     1) echo "$R/bench_tpu_r4_512.json" ;;
     2) echo "$R/hbm_micro_tpu_r5.json" ;;
-    3) echo "$R/bench_tpu_r4_512_fused.json" ;;
-    4) echo "$R/bench_tpu_r4_512_fstream.json" ;;
-    5) echo "$R/bench_tpu_r4_512_segxla.json" ;;
-    6) echo "$R/bench_tpu_r5_512_bf16.json" ;;
-    7) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
-    8) echo "$R/bench_tpu_r4_256_r2config.json" ;;
-    9) echo "$R/bench_tpu_r4_256.json" ;;
-    10) echo "$R/bench_tpu_r4_512_c32.json" ;;
-    11) echo "$R/profile_march_512_r4.txt" ;;
-    12) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
-    13) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
-    14) echo "$R/bench_tpu_r4_1024.json" ;;
-    15) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
-    16) echo "$R/novel_view_tpu_r4.json" ;;
-    17) echo "$R/composite_tpu_r4.json" ;;
-    18) echo "$R/scaling_tpu_r4.json" ;;
-    19) echo "$R/profile_frame_tpu_r4.json" ;;
-    20) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
-    21) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
-    22) echo "$R/novel_view_study_tpu_r5.json" ;;
+    3) echo "$R/bench_tpu_r5_512_render.json" ;;
+    4) echo "$R/bench_tpu_r4_512_fused.json" ;;
+    5) echo "$R/bench_tpu_r4_512_fstream.json" ;;
+    6) echo "$R/bench_tpu_r4_512_segxla.json" ;;
+    7) echo "$R/bench_tpu_r5_512_bf16.json" ;;
+    8) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
+    9) echo "$R/bench_tpu_r4_256_r2config.json" ;;
+    10) echo "$R/bench_tpu_r4_256.json" ;;
+    11) echo "$R/bench_tpu_r4_512_c32.json" ;;
+    12) echo "$R/profile_march_512_r4.txt" ;;
+    13) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
+    14) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
+    15) echo "$R/bench_tpu_r4_1024.json" ;;
+    16) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
+    17) echo "$R/novel_view_tpu_r4.json" ;;
+    18) echo "$R/composite_tpu_r4.json" ;;
+    19) echo "$R/scaling_tpu_r4.json" ;;
+    20) echo "$R/profile_frame_tpu_r4.json" ;;
+    21) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
+    22) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
+    23) echo "$R/novel_view_study_tpu_r5.json" ;;
   esac
 }
 
-NSTEPS=22
+NSTEPS=23
 MAXFAIL=2
 for i in $(seq 1 900); do
   next=""

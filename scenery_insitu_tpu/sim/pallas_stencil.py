@@ -34,31 +34,35 @@ from jax.experimental.pallas import tpu as pltpu
 
 # nominal bytes of live blocks per grid step; Mosaic double-buffers the
 # pipelined inputs/outputs, so this must stay well under the part's VMEM.
-# The figure is a HEURISTIC (not yet validated on hardware — the round-2
-# TPU window closed first): `fused_supported`/`multi_step_pallas` therefore
-# verify each (shape, T) choice with a one-time Mosaic compile probe and
-# degrade to smaller T / the XLA roll path instead of trusting it.
-_VMEM_BUDGET = int(os.environ.get("SITPU_STENCIL_VMEM_MB", "24")) \
+# The figure is a HEURISTIC screen only — `fused_supported` /
+# `multi_step_pallas` verify each (shape, T) choice with a one-time
+# Mosaic compile probe and degrade to smaller T / the XLA roll path, so
+# the budget's job is merely to skip probing hopeless candidates. The
+# original 24 MB default silently pinned the 512^3 flagship to T=1
+# (full 2 GB/step HBM traffic, ~20 GB of the measured 29 GB frame);
+# 96 MB admits T=2/tz=4 (40 MB nominal) and lets the probe — not the
+# heuristic — decide what this part's 128 MB VMEM really accepts.
+_VMEM_BUDGET = int(os.environ.get("SITPU_STENCIL_VMEM_MB", "96")) \
     * 1024 * 1024
 
 # (shape, t_steps) -> did Mosaic accept the fused kernel?
 _PROBE_CACHE: dict = {}
 
 
-def _compile_ok(shape, t_steps: int) -> bool:
-    """One-time probe: does the fused kernel at this (shape, T) actually
-    compile on the current TPU? A VMEM budget miss surfaces as a Mosaic
-    resource-exhausted error at compile time — catch it HERE, where a
-    fallback exists, not inside a traced frame step where it cannot be
-    caught. Cached per process (and cheap on repeats via the persistent
-    JAX compile cache)."""
-    key = (tuple(shape), int(t_steps))
+def _compile_ok(shape, t_steps: int, tz: int = 0) -> bool:
+    """One-time probe: does the fused kernel at this (shape, T, tz)
+    actually compile on the current TPU? A VMEM budget miss surfaces as a
+    Mosaic resource-exhausted error at compile time — catch it HERE,
+    where a fallback exists, not inside a traced frame step where it
+    cannot be caught. Cached per process (and cheap on repeats via the
+    persistent JAX compile cache)."""
+    key = (tuple(shape), int(t_steps), int(tz))
     ok = _PROBE_CACHE.get(key)
     if ok is None:
         try:
             s = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
             p = jax.ShapeDtypeStruct((5,), jnp.float32)
-            step_pallas.lower(s, s, p, t_steps=t_steps).compile()
+            step_pallas.lower(s, s, p, t_steps=t_steps, tz=tz).compile()
             ok = True
         except Exception:
             ok = False
@@ -71,11 +75,12 @@ def fused_supported(shape, t_steps: int = 1) -> bool:
     a slab fits the nominal budget AND (on TPU) Mosaic accepts the
     kernel. The gate `sim.grayscott.multi_step_fast` consults before
     choosing the Pallas path."""
-    if pick_tz(shape, t_steps) == 0:
+    cands = tz_candidates(shape, t_steps)
+    if not cands:
         return False
     if jax.default_backend() != "tpu":
         return True          # interpret mode has no VMEM to exhaust
-    return _compile_ok(shape, t_steps)
+    return any(_compile_ok(shape, t_steps, c) for c in cands[:2])
 
 
 def _roll(x: jnp.ndarray, shift: int, axis: int) -> jnp.ndarray:
@@ -111,32 +116,44 @@ def _kernel(t_steps, p_ref, u_ref, v_ref, uzm_ref, uzp_ref, vzm_ref,
     vo_ref[...] = v[t:v.shape[0] - t]
 
 
-def pick_tz(shape, t_steps: int = 1) -> int:
-    """Largest z-slab size for a T-step fused call fitting the VMEM budget
-    and the divisibility constraints (0 = does not fit): tz | D so the
-    grid tiles exactly, and T | tz so the T-slice halos are expressible as
-    whole (T, H, W) blocks."""
+def tz_candidates(shape, t_steps: int = 1) -> tuple:
+    """z-slab sizes for a T-step fused call fitting the VMEM budget and
+    the divisibility constraints, largest first: tz | D so the grid tiles
+    exactly, and T | tz so the T-slice halos are expressible as whole
+    (T, H, W) blocks. The budget is a screen; the Mosaic compile probe
+    (`_compile_ok`) is the authority, so `multi_step_pallas` walks this
+    list until one compiles instead of betting everything on the
+    nominal-largest choice."""
     d, h, w = shape
     plane = h * w * 4
+    out = []
     for tz in (32, 16, 8, 4, 2, 1):
         if d % tz or tz % t_steps:
             continue
         # live VMEM: ~4 arrays (u, v and temporaries) of the haloed slab
         # plus the two output slabs
         if (4 * (tz + 2 * t_steps) + 2 * tz) * plane <= _VMEM_BUDGET:
-            return tz
-    return 0
+            out.append(tz)
+    return tuple(out)
 
 
-@functools.partial(jax.jit, static_argnames=("t_steps", "interpret"))
+def pick_tz(shape, t_steps: int = 1) -> int:
+    """Largest nominally-fitting z-slab size (0 = none fits)."""
+    cands = tz_candidates(shape, t_steps)
+    return cands[0] if cands else 0
+
+
+@functools.partial(jax.jit, static_argnames=("t_steps", "interpret", "tz"))
 def step_pallas(u: jnp.ndarray, v: jnp.ndarray, params_vec: jnp.ndarray,
-                t_steps: int = 1, interpret: bool = False):
+                t_steps: int = 1, interpret: bool = False, tz: int = 0):
     """Advance ``t_steps`` Gray-Scott steps in one fused kernel pass.
     ``params_vec = [f, k, du, dv, dt]`` (f32[5]). Requires
-    ``pick_tz(u.shape, t_steps) > 0``."""
+    ``pick_tz(u.shape, t_steps) > 0``. ``tz=0`` auto-picks the largest
+    nominally-fitting slab; an explicit tz must come from
+    `tz_candidates`."""
     d, h, w = u.shape
     t = t_steps
-    tz = pick_tz(u.shape, t)
+    tz = tz or pick_tz(u.shape, t)
     if tz == 0:
         raise ValueError(
             f"grid {u.shape} does not fit the VMEM budget at T={t}")
@@ -173,13 +190,22 @@ def multi_step_pallas(u, v, params_vec, n: int, interpret: bool = False):
     on_tpu = jax.default_backend() == "tpu" and not interpret
     for t in range(min(_FUSE_T, n), 0, -1):
         reps = remaining // t
-        if reps == 0 or pick_tz(u.shape, t) == 0:
+        cands = tz_candidates(u.shape, t)
+        if reps == 0 or not cands:
             continue
-        if on_tpu and not _compile_ok(u.shape, t):
-            continue         # Mosaic rejected this T: degrade, don't die
+        if on_tpu:
+            # walk the two largest nominal fits — the budget is a screen
+            # and Mosaic the authority, but each probe is a real compile,
+            # so the walk is capped to keep warmup bounded
+            tz = next((c for c in cands[:2]
+                       if _compile_ok(u.shape, t, c)), 0)
+            if tz == 0:
+                continue     # Mosaic rejected this T: degrade, don't die
+        else:
+            tz = cands[0]
         s = jax.lax.fori_loop(
-            0, reps, lambda _, s, t=t: step_pallas(s[0], s[1], params_vec,
-                                                   t, interpret=interpret),
+            0, reps, lambda _, s, t=t, tz=tz: step_pallas(
+                s[0], s[1], params_vec, t, interpret=interpret, tz=tz),
             s)
         remaining -= reps * t
         if remaining == 0:

@@ -111,8 +111,8 @@ def test_stencil_compile_probe_gates_fused_path():
     shape = (8, 8, 128)
     assert ps.pick_tz(shape) > 0
     ps._PROBE_CACHE.clear()
-    assert ps._compile_ok(shape, 1) is False      # swallowed, not raised
-    assert ps._PROBE_CACHE[(shape, 1)] is False   # cached
+    assert ps._compile_ok(shape, 1) is False        # swallowed, not raised
+    assert ps._PROBE_CACHE[(shape, 1, 0)] is False  # cached (tz=0 = auto)
     # fused_supported skips the probe off-TPU (interpret mode is safe)
     assert ps.fused_supported(shape)
     ps._PROBE_CACHE.clear()

@@ -87,6 +87,24 @@ def main():
     mm = jax.jit(lambda p, q: (p @ q).astype(jnp.bfloat16))
     t_mm = _time(mm, a, a, iters=5)
 
+    # dispatch tax of the axon tunnel: a trivial jitted op, called
+    # back-to-back with async dispatch exactly like the bench frame loop.
+    # If per-call wall time is ~ms, dispatch overhead is negligible and
+    # frame times are device times; if it is tens of ms, every recorded
+    # frame number carries a per-execute RPC tax and kernel-schedule A/Bs
+    # are fogged by it.
+    tiny = jax.jit(lambda s: s + 1.0)
+    t_tiny = _time(tiny, jnp.float32(0.0), iters=100, warmup=3)
+
+    # and a dependent chain (each call consumes the previous result):
+    # pipelined transports hide round trips here; a synchronous shim
+    # cannot
+    def chain(s, n=10):
+        for _ in range(n):
+            s = tiny(s)
+        return s
+    t_chain = _time(chain, jnp.float32(0.0), iters=5) / 10.0
+
     gb = 1e9
     sim_bytes = 10 * 4 * g ** 3 * 4.0            # 10 steps x (r+w of u,v)
     out = {
@@ -98,6 +116,8 @@ def main():
         "sim10_ms": round(t_sim * 1e3, 2),
         "sim10_gbps_floor": round(sim_bytes / t_sim / gb, 1),
         "matmul_tflops": round(2.0 * m ** 3 / t_mm / 1e12, 1),
+        "dispatch_tiny_us": round(t_tiny * 1e6, 1),
+        "dispatch_chain_us": round(t_chain * 1e6, 1),
         "buf_mb": nbytes >> 20,
         "flagship_frame_gb": 29.0,
         "implied_frame_ms_at_copy_bw": round(

@@ -249,15 +249,49 @@ def main():
           flush=True)
 
     import math
-    t0 = time.perf_counter()
-    for i in range(frames):
-        yaw = 0.35 * math.sin(0.7 * (i + 1))
-        if temporal:
-            c, d, u, v, thr = frame(u, v, jnp.float32(yaw), thr)
-        else:
-            c, d, u, v = frame(u, v, jnp.float32(yaw))
-    jax.block_until_ready(c)
-    dt = (time.perf_counter() - t0) / frames
+    # SCAN_FRAMES=1: run the whole frame loop as ONE lax.scan inside ONE
+    # jit call — a single executable launch for all frames. If the axon
+    # shim taxes every launch (dispatch_tiny_us in hbm_bench decides),
+    # this A/B isolates that tax from real device time. Per-frame means
+    # of the VDI planes are returned so every frame's fold stays live
+    # (no DCE of non-final frames); sim/threshold state is carried.
+    scan_frames = _env_int("SITPU_BENCH_SCAN_FRAMES", 0)
+    yaw_arr = jnp.asarray([0.35 * math.sin(0.7 * (i + 1))
+                           for i in range(frames)], jnp.float32)
+    partial_jit_donate = lambda f: jax.jit(f, donate_argnums=(0, 1, 2))
+    if scan_frames and temporal:
+        @partial_jit_donate
+        def run_all(u, v, thr, yaws):
+            def body(carry, yaw):
+                u, v, thr = carry
+                c, d, u, v, thr = frame_step(u, v, orbit(base, yaw).eye,
+                                             thr)
+                return (u, v, thr), (jnp.mean(c), jnp.mean(d))
+            carry, means = jax.lax.scan(body, (u, v, thr), yaws)
+            return carry, means
+
+        # warm the scan-loop executable too (compile excluded from timing)
+        (u, v, thr), _ = run_all(u, v, thr, yaw_arr)
+        jax.block_until_ready(u)
+        t0 = time.perf_counter()
+        (u, v, thr), means = run_all(u, v, thr, yaw_arr)
+        jax.block_until_ready(means)
+        dt = (time.perf_counter() - t0) / frames
+        c, d, u, v, thr = frame(u, v, jnp.float32(0.0), thr)
+    else:
+        if scan_frames:
+            print("[bench] SCAN_FRAMES needs temporal mxu mode; ignoring",
+                  file=sys.stderr, flush=True)
+            scan_frames = 0
+        t0 = time.perf_counter()
+        for i in range(frames):
+            yaw = yaw_arr[i]
+            if temporal:
+                c, d, u, v, thr = frame(u, v, yaw, thr)
+            else:
+                c, d, u, v = frame(u, v, yaw)
+        jax.block_until_ready(c)
+        dt = (time.perf_counter() - t0) / frames
 
     fps = 1.0 / dt
     # report what was actually rendered: the mxu engine marches the volume's
@@ -310,6 +344,8 @@ def main():
     # only renders (README.md:4-8), so render-only fps is the number its
     # harness would have produced
     tag = "_render_only" if sim_steps == 0 else ""
+    if scan_frames:
+        tag += "_scanloop"
     print(json.dumps({
         "metric": f"gray_scott_{grid}c_vdi_fps_{res_tag}_{platform}"
                   f"_1chip{tag}",
@@ -332,7 +368,7 @@ def main():
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
-                   "chunk": chunk,
+                   "chunk": chunk, "scan_frames": bool(scan_frames),
                    "compile_s": round(compile_s, 1),
                    "platform": platform, "device": dev.device_kind,
                    "assumed_peak_tflops": (peak / 1e12 if peak else None),

@@ -62,3 +62,33 @@ def test_bench_reports_failed_attempts_on_fallback(tmp_path):
                     if l.startswith("{")][-1])
     assert d["value"] is not None
     assert any("nope" in e for e in d.get("failed_attempts", [])), d
+
+
+def test_bench_scanloop_render_only_modes():
+    """The round-5 diagnostic modes: SIM_STEPS=0 (render-only, the
+    reference FPS-harness semantics) + SCAN_FRAMES=1 (whole loop in one
+    lax.scan executable) must produce the tagged metric and a real
+    number — these are the watcher's dispatch-tax / in-situ-split A/Bs,
+    so a silent breakage would burn a hardware window."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": _ROOT,
+        "SITPU_BENCH_PLATFORMS": "cpu",
+        "SITPU_BENCH_GRID": "24",
+        "SITPU_BENCH_K": "4",
+        "SITPU_BENCH_FRAMES": "2",
+        "SITPU_BENCH_SIM_STEPS": "0",
+        "SITPU_BENCH_SCAN_FRAMES": "1",
+        "SITPU_BENCH_CHILD_TIMEOUT": "420",
+    })
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True, timeout=480)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads([l for l in p.stdout.strip().splitlines()
+                    if l.startswith("{")][-1])
+    assert d["value"] is not None and d["value"] > 0
+    assert d["metric"].endswith("_render_only_scanloop"), d["metric"]
+    assert d["config"]["scan_frames"] is True
+    assert d["config"]["sim_steps"] == 0
+    # render-only is not the sim-in-loop primary config: vs_baseline null
+    assert d["vs_baseline"] is None

@@ -71,16 +71,19 @@ def _compile_ok(shape, t_steps: int, tz: int = 0) -> bool:
 
 
 def fused_supported(shape, t_steps: int = 1) -> bool:
-    """Can the fused kernel run this grid on the current backend? True iff
-    a slab fits the nominal budget AND (on TPU) Mosaic accepts the
-    kernel. The gate `sim.grayscott.multi_step_fast` consults before
-    choosing the Pallas path."""
-    cands = tz_candidates(shape, t_steps)
-    if not cands:
+    """Can a fused kernel (1D z-slab or 2D z×h tile) run this grid on the
+    current backend? True iff some tile fits the nominal budget AND (on
+    TPU) Mosaic accepts one of the capped-walk candidates. The gate
+    `sim.grayscott.multi_step_fast` consults this before choosing the
+    Pallas path; `_best_schedule` then picks the cheapest compiling
+    schedule."""
+    on_tpu = jax.default_backend() == "tpu"
+    if not (tz_candidates(shape, t_steps)
+            or tile2d_candidates(shape, t_steps)):
         return False
-    if jax.default_backend() != "tpu":
+    if not on_tpu:
         return True          # interpret mode has no VMEM to exhaust
-    return any(_compile_ok(shape, t_steps, c) for c in cands[:2])
+    return _best_schedule(shape, t_steps, True) is not None
 
 
 def _roll(x: jnp.ndarray, shift: int, axis: int) -> jnp.ndarray:
@@ -190,23 +193,21 @@ def multi_step_pallas(u, v, params_vec, n: int, interpret: bool = False):
     on_tpu = jax.default_backend() == "tpu" and not interpret
     for t in range(min(_FUSE_T, n), 0, -1):
         reps = remaining // t
-        cands = tz_candidates(u.shape, t)
-        if reps == 0 or not cands:
+        if reps == 0:
             continue
-        if on_tpu:
-            # walk the two largest nominal fits — the budget is a screen
-            # and Mosaic the authority, but each probe is a real compile,
-            # so the walk is capped to keep warmup bounded
-            tz = next((c for c in cands[:2]
-                       if _compile_ok(u.shape, t, c)), 0)
-            if tz == 0:
-                continue     # Mosaic rejected this T: degrade, don't die
-        else:
-            tz = cands[0]
-        s = jax.lax.fori_loop(
-            0, reps, lambda _, s, t=t, tz=tz: step_pallas(
-                s[0], s[1], params_vec, t, interpret=interpret, tz=tz),
-            s)
+        sched = _best_schedule(u.shape, t, on_tpu)
+        if sched is None:
+            continue         # Mosaic rejected this T: degrade, don't die
+        kind, tz, th = sched
+
+        def one(s, t=t, kind=kind, tz=tz, th=th):
+            if kind == "2d":
+                return step_pallas2d(s[0], s[1], params_vec, t,
+                                     interpret=interpret, tz=tz, th=th)
+            return step_pallas(s[0], s[1], params_vec, t,
+                               interpret=interpret, tz=tz)
+
+        s = jax.lax.fori_loop(0, reps, lambda _, s: one(s), s)
         remaining -= reps * t
         if remaining == 0:
             break
@@ -216,3 +217,175 @@ def multi_step_pallas(u, v, params_vec, n: int, interpret: bool = False):
 
 
 _FUSE_T = 4
+
+
+# ------------------------------------------------- 2D-blocked (z x h) fusion
+#
+# At 512^3 a full (H, W) plane is 1 MB, so the z-only slab above cannot
+# afford a useful T at any tz — the kernel's ~6 live haloed-slab copies
+# exhaust VMEM (the round-5 flagship ran the sim at T=1: a full 2 GB of
+# HBM traffic per step, ~20 GB of the measured 29 GB frame). Blocking z
+# AND h shrinks the live set quadratically while the halo overhead stays
+# linear in T, so T=4 fits 512^3 comfortably: per T steps the volume is
+# read ((tz+2T)(th+2T))/(tz·th) ≈ 1.6x and written once — ~3x less HBM
+# traffic per step than the best 1D schedule at this scale.
+#
+# Geometry: the T-step dependency cone of the 6-point Laplacian is an L1
+# ball, covered by a square halo of width T in (z, h). Each field reads
+# 9 views of the same HBM array (center + 4 edges + 4 corners, periodic
+# wrap via index_map arithmetic in block units — requiring T | tz | D
+# and T | th | H); in-kernel, rows of blocks are concatenated into one
+# (tz+2T, th+2T, W) padded array. z and h neighbors use edge-replicated
+# shifts (the replicated rim is exactly the region whose validity the
+# per-step shrink discards); w neighbors keep the Mosaic rotate because
+# w is the full, truly-periodic lane axis.
+
+
+def _kernel2d(t_steps, p_ref,
+              uc, un, us, uw, ue, unw, une, usw, use_,
+              vc, vn, vs, vw, ve, vnw, vne, vsw, vse,
+              uo_ref, vo_ref):
+    f, k, du, dv, dt = (p_ref[i] for i in range(5))
+    t = t_steps
+
+    def pad(n, w_, c, e, nw, ne, s, sw, se):
+        top = jnp.concatenate([nw[...], n[...], ne[...]], axis=1)
+        mid = jnp.concatenate([w_[...], c[...], e[...]], axis=1)
+        bot = jnp.concatenate([sw[...], s[...], se[...]], axis=1)
+        return jnp.concatenate([top, mid, bot], axis=0)
+
+    u = pad(un, uw, uc, ue, unw, une, us, usw, use_)
+    v = pad(vn, vw, vc, ve, vnw, vne, vs, vsw, vse)
+
+    def lap(x):
+        zm = jnp.concatenate([x[:1], x[:-1]], axis=0)
+        zp = jnp.concatenate([x[1:], x[-1:]], axis=0)
+        hm = jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)
+        hp = jnp.concatenate([x[:, 1:], x[:, -1:]], axis=1)
+        return (zm + zp + hm + hp
+                + _roll(x, 1, 2) + _roll(x, -1, 2) - 6.0 * x)
+
+    for _ in range(t):
+        uvv = u * v * v
+        u, v = (u + dt * (du * lap(u) - uvv + f * (1.0 - u)),
+                v + dt * (dv * lap(v) + uvv - (f + k) * v))
+
+    uo_ref[...] = u[t:u.shape[0] - t, t:u.shape[1] - t]
+    vo_ref[...] = v[t:v.shape[0] - t, t:v.shape[1] - t]
+
+
+def tile2d_candidates(shape, t_steps: int = 1) -> tuple:
+    """(tz, th) tiles for the 2D-blocked T-step kernel fitting the VMEM
+    screen, best-first by modeled HBM traffic per step. Constraints:
+    T | tz | D, T | th | H (halo/corner views are whole blocks of the
+    halo shapes), and w stays whole (the periodic lane axis)."""
+    d, h, w = shape
+    t = t_steps
+    cands = []
+    for tz in (32, 16, 8, 4):
+        if d % tz or tz % t:
+            continue
+        for th in (256, 128, 64, 32):
+            if h % th or th % t:
+                continue
+            # ~6 live copies of the padded block (u, v, laplacian
+            # temporaries) + the two output blocks
+            live = (6 * (tz + 2 * t) * (th + 2 * t) + 2 * tz * th) * w * 4
+            if live > _VMEM_BUDGET:
+                continue
+            # HBM traffic per step per field, in units of volume bytes:
+            # (read amplification + 1 write) / T
+            traffic = ((tz + 2 * t) * (th + 2 * t) / (tz * th) + 1.0) / t
+            cands.append((traffic, tz, th))
+    cands.sort()
+    return tuple((tz, th) for _, tz, th in cands)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("t_steps", "interpret", "tz", "th"))
+def step_pallas2d(u, v, params_vec, t_steps: int = 1,
+                  interpret: bool = False, tz: int = 0, th: int = 0):
+    """Advance ``t_steps`` steps in one 2D-blocked fused pass. (tz, th)
+    must come from `tile2d_candidates` (0 auto-picks the best nominal
+    fit)."""
+    d, h, w = u.shape
+    t = t_steps
+    if not (tz and th):
+        cands = tile2d_candidates(u.shape, t)
+        if not cands:
+            raise ValueError(
+                f"grid {u.shape} has no 2D tile fitting the VMEM screen "
+                f"at T={t}")
+        tz, th = cands[0]
+    nzb, nhb = d // tz, h // th
+    nz_t, nh_t = d // t, h // t    # array length in halo-block units
+    rz, rh = tz // t, th // t
+
+    c_ = pl.BlockSpec((tz, th, w), lambda i, j: (i, j, 0))
+    # edge views in halo-block units (periodic wrap by modular index)
+    n_ = pl.BlockSpec((t, th, w), lambda i, j: ((i * rz - 1) % nz_t, j, 0))
+    s_ = pl.BlockSpec((t, th, w), lambda i, j: ((i + 1) * rz % nz_t, j, 0))
+    w_ = pl.BlockSpec((tz, t, w), lambda i, j: (i, (j * rh - 1) % nh_t, 0))
+    e_ = pl.BlockSpec((tz, t, w), lambda i, j: (i, (j + 1) * rh % nh_t, 0))
+    nw = pl.BlockSpec((t, t, w),
+                      lambda i, j: ((i * rz - 1) % nz_t,
+                                    (j * rh - 1) % nh_t, 0))
+    ne = pl.BlockSpec((t, t, w),
+                      lambda i, j: ((i * rz - 1) % nz_t,
+                                    (j + 1) * rh % nh_t, 0))
+    sw = pl.BlockSpec((t, t, w),
+                      lambda i, j: ((i + 1) * rz % nz_t,
+                                    (j * rh - 1) % nh_t, 0))
+    se = pl.BlockSpec((t, t, w),
+                      lambda i, j: ((i + 1) * rz % nz_t,
+                                    (j + 1) * rh % nh_t, 0))
+
+    specs = [c_, n_, s_, w_, e_, nw, ne, sw, se]
+    return pl.pallas_call(
+        functools.partial(_kernel2d, t),
+        grid=(nzb, nhb),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + specs + specs,
+        out_specs=[c_, c_],
+        out_shape=[jax.ShapeDtypeStruct((d, h, w), jnp.float32)] * 2,
+        interpret=interpret,
+    )(params_vec, *([u] * 9), *([v] * 9))
+
+
+def _compile2d_ok(shape, t_steps: int, tz: int, th: int) -> bool:
+    """Mosaic probe for the 2D kernel at (shape, T, tz, th); cached."""
+    key = ("2d", tuple(shape), int(t_steps), int(tz), int(th))
+    ok = _PROBE_CACHE.get(key)
+    if ok is None:
+        try:
+            s = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
+            p = jax.ShapeDtypeStruct((5,), jnp.float32)
+            step_pallas2d.lower(s, s, p, t_steps=t_steps,
+                                tz=tz, th=th).compile()
+            ok = True
+        except Exception:
+            ok = False
+        _PROBE_CACHE[key] = ok
+    return ok
+
+
+def _best_schedule(shape, t: int, on_tpu: bool):
+    """Pick the cheapest compiling schedule for a T-step pass: 2D tiles
+    and 1D slabs compete on modeled HBM traffic per step; the Mosaic
+    probe (capped walk) has the final word. Returns ("2d", tz, th),
+    ("1d", tz, None) or None."""
+    opts = []
+    for tz, th in tile2d_candidates(shape, t)[:2]:
+        traffic = ((tz + 2 * t) * (th + 2 * t) / (tz * th) + 1.0) / t
+        opts.append((traffic, "2d", tz, th))
+    for tz in tz_candidates(shape, t)[:2]:
+        traffic = ((tz + 2 * t) / tz + 1.0) / t
+        opts.append((traffic, "1d", tz, None))
+    opts.sort(key=lambda o: o[0])
+    for _, kind, tz, th in opts[:3]:
+        if not on_tpu:
+            return kind, tz, th
+        ok = (_compile2d_ok(shape, t, tz, th) if kind == "2d"
+              else _compile_ok(shape, t, tz))
+        if ok:
+            return kind, tz, th
+    return None

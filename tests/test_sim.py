@@ -116,3 +116,41 @@ def test_stencil_compile_probe_gates_fused_path():
     # fused_supported skips the probe off-TPU (interpret mode is safe)
     assert ps.fused_supported(shape)
     ps._PROBE_CACHE.clear()
+
+
+@pytest.mark.parametrize("t_steps", [2, 4])
+def test_pallas_stencil_2d_multistep_parity(t_steps):
+    """T fused steps of the 2D-blocked (z x h) kernel ≡ T single XLA
+    steps — the square T-halo (edges + corners, periodic wrap in BOTH
+    blocked axes via index_map arithmetic) must keep every central tile
+    exact. The asymmetric grid makes a z/h axis swap impossible to miss."""
+    from scenery_insitu_tpu.sim import pallas_stencil as ps
+
+    st = gs.GrayScott.init((16, 32, 128), n_seeds=3)
+    p = st.params
+    pvec = jnp.stack([p.f, p.k, p.du, p.dv, p.dt])
+    cands = ps.tile2d_candidates(st.u.shape, t_steps)
+    assert cands, "no 2D tile for the test grid"
+    # exercise a non-trivial grid in both axes, not just the best tile
+    tz, th = [c for c in cands if c[0] < 16 and c[1] < 32][0] \
+        if any(c[0] < 16 and c[1] < 32 for c in cands) else cands[-1]
+    u2, v2 = ps.step_pallas2d(st.u, st.v, pvec, t_steps, interpret=True,
+                              tz=tz, th=th)
+    ref = st
+    for _ in range(t_steps):
+        ref = gs.step(ref)
+    np.testing.assert_allclose(np.asarray(ref.u), np.asarray(u2), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref.v), np.asarray(v2), atol=1e-5)
+
+
+def test_best_schedule_prefers_lower_traffic():
+    """_best_schedule must rank 2D tiles above the 1D slab when the
+    modeled per-step traffic is lower (the 512^3 regime), and fall back
+    to 1D when no 2D tile exists."""
+    from scenery_insitu_tpu.sim import pallas_stencil as ps
+
+    kind, tz, th = ps._best_schedule((512, 512, 512), 4, on_tpu=False)
+    assert kind == "2d" and tz % 4 == 0 and th % 4 == 0
+    # h=48 admits no th in (256,128,64,32): only the 1D slab remains
+    sched = ps._best_schedule((64, 48, 128), 1, on_tpu=False)
+    assert sched is not None and sched[0] == "1d"

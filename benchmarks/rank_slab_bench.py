@@ -1,0 +1,165 @@
+"""Per-rank cost of BASELINE Config 2 (the PRIMARY metric's own terms).
+
+BASELINE.md defines the primary metric as "Gray-Scott 512^3 FPS +
+VDI-composite ms/frame" on **v5e-8** — an 8-rank sort-last pipeline
+(Config 2), where each chip sims and marches a D/8 z-slab and
+composites one W/8 output strip. Every committed flagship number so far
+measured the WHOLE 512^3 volume on ONE chip, i.e. 8x the per-rank march
+work the metric actually asks one chip to do.
+
+Only one chip is reachable through the axon tunnel, so this harness
+measures the real per-rank constituents on it and models the one part
+that needs 8 chips (the ICI all_to_all), with the assumption printed:
+
+  sim_slab    10 Gray-Scott steps of the [D/n, H, W] slab
+              (multi_step_fast — the production path; the ~4 MB/step
+              halo exchange the real pipeline overlaps is noted, not
+              modeled)
+  march_slab  one temporal write march of the slab through the real
+              distributed geometry (shifted origin + global clip box,
+              exactly what _mxu_rank_generate runs per rank), VDI on
+              the full virtual pixel grid
+  composite   composite_vdis over n rank-VDI column strips ([n, K, 4,
+              Nj, Ni/n] — the real shapes; contents replicated, cost
+              identical)
+  a2a_model   per-chip egress (n-1)/n of the VDI bytes at an ASSUMED
+              ICI effective bandwidth (default 45 GB/s per chip,
+              overridable via SITPU_A2A_GBPS)
+
+Prints ONE JSON line with the pieces and two projections:
+projected_fps_v5e8 (sim + march + a2a + composite) and
+projected_render_fps_v5e8 (in-situ split: sim feeds from elsewhere).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig, \
+    CompositeConfig
+from scenery_insitu_tpu.core.camera import Camera
+from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.ops import slicer
+from scenery_insitu_tpu.ops.composite import composite_vdis
+from scenery_insitu_tpu.core.transfer import for_dataset
+from scenery_insitu_tpu.sim import grayscott as gs
+
+
+def _t(fn, *args, iters=5, warmup=1):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    if os.environ.get("SITPU_CPU") == "1":
+        from scenery_insitu_tpu.utils.backend import pin_cpu_backend
+        pin_cpu_backend()
+    dev = jax.devices()[0]
+    grid = int(os.environ.get("SITPU_BENCH_GRID", "512"))
+    n = int(os.environ.get("SITPU_BENCH_RANKS", "8"))
+    k = int(os.environ.get("SITPU_BENCH_K", "16"))
+    sim_steps = int(os.environ.get("SITPU_BENCH_SIM_STEPS", "10"))
+    a2a_gbps = float(os.environ.get("SITPU_A2A_GBPS", "45"))
+    fold = os.environ.get("SITPU_BENCH_FOLD", "auto")
+
+    d_loc = grid // n
+    cam = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
+    march_cfg = SliceMarchConfig(fold=fold, chunk=min(16, d_loc))
+    vdi_cfg = VDIConfig(max_supersegments=k, adaptive_mode="temporal")
+    comp_cfg = CompositeConfig(max_output_supersegments=k)
+    tf = for_dataset("gray_scott")
+
+    # ---- per-rank slab state: middle slab of a developed global field
+    st = gs.GrayScott.init((grid, grid, grid))
+    st = jax.jit(lambda s: gs.multi_step(s, 5))(st)
+    r0 = (n // 2) * d_loc
+    slab_u = st.u[r0:r0 + d_loc]
+    slab_v = st.v[r0:r0 + d_loc]
+    slab = gs.GrayScott(slab_u, slab_v, st.params)
+
+    # ---- sim of one slab (the production fast path)
+    sim_fn = jax.jit(lambda s: gs.multi_step_fast(s, sim_steps))
+    t_sim, _ = _t(sim_fn, slab, iters=3)
+
+    # ---- per-rank march: the distributed geometry (shifted origin,
+    # global clip box), exactly what _mxu_rank_generate does per rank
+    # (parallel/pipeline.py), VDI on the full virtual pixel grid
+    spacing = 2.0 / grid
+    g_origin = jnp.array([-1.0 + 0.5 * spacing] * 3, jnp.float32)
+    l_origin = g_origin.at[2].add(r0 * spacing)   # z slab offset (D axis)
+    vol = Volume.create(slab_v, origin=l_origin,
+                        spacing=jnp.array([spacing] * 3, jnp.float32))
+    spec = slicer.make_spec(cam, (grid, grid, grid), march_cfg)
+    box_min = g_origin - 0.5 * spacing
+    box_max = box_min + 2.0
+
+    thr = slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg,
+                                   box_min=box_min, box_max=box_max)
+
+    @jax.jit
+    def march(vol_data, thr):
+        v2 = Volume(vol_data, vol.origin, vol.spacing)
+        vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
+            v2, tf, cam, spec, thr, vdi_cfg, box_min=box_min,
+            box_max=box_max)
+        return vdi.color, vdi.depth, thr2
+
+    t_march, (color, depth, _) = _t(march, vol.data, thr, iters=5)
+
+    # ---- composite over n rank strips (real shapes, replicated content)
+    ni = spec.ni
+    strip = ni // n
+    colors = jnp.stack([color[..., :strip]] * n)   # [n, K, 4, Nj, Ni/n]
+    depths = jnp.stack([depth[..., :strip]] * n)
+
+    @jax.jit
+    def comp(colors, depths):
+        out = composite_vdis(colors, depths, comp_cfg)
+        return out.color, out.depth
+
+    t_comp, _ = _t(comp, colors, depths, iters=5)
+
+    # ---- modeled ICI all_to_all: per-chip egress of (n-1)/n VDI bytes
+    vdi_bytes = (color.size + depth.size) * 4
+    a2a_bytes = vdi_bytes * (n - 1) / n
+    t_a2a = a2a_bytes / (a2a_gbps * 1e9)
+
+    total = t_sim + t_march + t_a2a + t_comp
+    render = t_march + t_a2a + t_comp
+    print(json.dumps({
+        "metric": f"config2_per_rank_{grid}c_{n}ranks_projection",
+        "value": round(1.0 / total, 3),
+        "unit": "frames/s (projected v5e-8, a2a modeled)",
+        "per_rank_sim_ms": round(t_sim * 1e3, 2),
+        "per_rank_march_ms": round(t_march * 1e3, 2),
+        "composite_ms": round(t_comp * 1e3, 2),
+        "a2a_model_ms": round(t_a2a * 1e3, 3),
+        "a2a_assumed_gbps": a2a_gbps,
+        "a2a_bytes": round(a2a_bytes),
+        "projected_fps_v5e8": round(1.0 / total, 3),
+        "projected_render_fps_v5e8": round(1.0 / render, 3),
+        "note": ("per-rank sim+march+composite MEASURED on one chip with "
+                 "the real distributed slab geometry and shapes; ICI "
+                 "all_to_all modeled at the stated bandwidth; sim halo "
+                 "exchange (~4 MB/step) not modeled"),
+        "config": {"grid": grid, "ranks": n, "k": k,
+                   "sim_steps": sim_steps, "fold": spec.fold,
+                   "image": [spec.ni, spec.nj], "chunk": march_cfg.chunk,
+                   "platform": dev.platform, "device": dev.device_kind},
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

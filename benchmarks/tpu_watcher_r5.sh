@@ -16,7 +16,7 @@ cd "$(dirname "$0")/.." || exit 1
 mkdir -p benchmarks/results
 R=benchmarks/results
 L=/tmp/tpu_watcher_r5.log
-LAYOUT=r5v6
+LAYOUT=r5v7
 if [ "$(cat /tmp/r5_layout 2>/dev/null)" != "$LAYOUT" ]; then
   rm -f /tmp/r5_fail.*
   echo "$LAYOUT" > /tmp/r5_layout
@@ -89,16 +89,21 @@ run_step() {  # run_step <n>
     5) run_json "$R/bench_tpu_r5_512_scanloop.json" 900 env \
          SITPU_BENCH_SCAN_FRAMES=1 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 6: fused shade+fold kernel (rgba/depth streams never hit HBM)
-    6) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
+    # 6: BASELINE Config 2 on its own terms — per-rank slab sim/march/
+    # composite MEASURED (real distributed geometry + shapes), ICI a2a
+    # modeled with stated bandwidth: the honest v5e-8 projection
+    6) run_json "$R/rank_slab_tpu_r5.json" 900 \
+         python benchmarks/rank_slab_bench.py ;;
+    # 7: fused shade+fold kernel (rgba/depth streams never hit HBM)
+    7) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
          SITPU_BENCH_FOLD=pallas_fused SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 4: whole-march stream fold ([K] state crosses HBM once per march)
-    7) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
+    8) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
          SITPU_BENCH_FOLD=fused_stream SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 5: pure-XLA seg fold (Mosaic-free A/B)
-    8) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
+    9) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 9: the missing cell of the (fold x mode) matrix at 512: round 2's
@@ -106,67 +111,67 @@ run_step() {  # run_step <n>
     # 29 ms while {pallas, temporal} did ONE in 49 ms, contradicting the
     # synthetic-stream microbench; this tests whether the frame-context
     # XLA fold wins at the flagship scale too
-    9) run_json "$R/bench_tpu_r5_512_xlahist.json" 900 env \
+    10) run_json "$R/bench_tpu_r5_512_xlahist.json" 900 env \
          SITPU_BENCH_FOLD=xla SITPU_BENCH_ADAPTIVE_MODE=histogram \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
          python bench.py ;;
     # 10: bf16 RENDER copy — the HBM-traffic lever (matmuls already bf16)
-    10) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
+    11) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
          SITPU_BENCH_RENDER_DTYPE=bf16 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 7: in-plane occupancy v-tiles
-    11) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
+    12) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
          SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 8: 256^3 exact round-2 config A/B (the regression attribution)
-    12) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
+    13) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
          SITPU_BENCH_GRID=256 SITPU_BENCH_ADAPTIVE_MODE=histogram \
          SITPU_BENCH_FOLD=xla SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 9: 256^3 round-default (temporal + seg fold)
-    13) run_json "$R/bench_tpu_r4_256.json" 900 env \
+    14) run_json "$R/bench_tpu_r4_256.json" 900 env \
          SITPU_BENCH_GRID=256 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 10: flagship at chunk 32
-    14) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
+    15) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
          SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # ---- medium steps: profiles and split microbench sweeps ----
     # 11: march-stage profile at 512 (where do the ms go?)
-    15) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
+    16) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
          python -u benchmarks/profile_march.py 512 ;;
     # 12: fold microbench, core schedules (floors + seg family)
-    16) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
+    17) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants none,count,xla,seg,pallas_seg ;;
     # 13: fold microbench, fused family (+ its controlled baselines)
-    17) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
+    18) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
     # 14: the 1024^3 north-star attempt (diagnosed OOM is also a result)
-    18) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
+    19) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
          SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
          python bench.py ;;
     # ---- the rest of the r4 queue ----
-    19) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
+    20) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
          --variants none,count,xla,seg,pallas_seg,pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
-    20) run_json "$R/novel_view_tpu_r4.json" 1500 \
+    21) run_json "$R/novel_view_tpu_r4.json" 1500 \
          python benchmarks/novel_view_bench.py --iters 3 ;;
-    21) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
+    22) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
          python benchmarks/composite_bench.py ;;
-    22) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
+    23) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
          python benchmarks/scaling_bench.py --grid 128 --frames 10 ;;
-    23) run_json "$R/profile_frame_tpu_r4.json" 1200 \
+    24) run_json "$R/profile_frame_tpu_r4.json" 1200 \
          python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
-    24) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
+    25) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 32 --variants xla,seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    25) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
+    26) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 64 --variants seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    26) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
+    27) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
          SITPU_BENCH_REAL=1 python benchmarks/novel_view_study.py ;;
   esac
 }
@@ -178,31 +183,32 @@ step_out() {
     3) echo "$R/bench_tpu_r5_512_render.json" ;;
     4) echo "$R/bench_tpu_r5_512_simfused.json" ;;
     5) echo "$R/bench_tpu_r5_512_scanloop.json" ;;
-    6) echo "$R/bench_tpu_r4_512_fused.json" ;;
-    7) echo "$R/bench_tpu_r4_512_fstream.json" ;;
-    8) echo "$R/bench_tpu_r4_512_segxla.json" ;;
-    9) echo "$R/bench_tpu_r5_512_xlahist.json" ;;
-    10) echo "$R/bench_tpu_r5_512_bf16.json" ;;
-    11) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
-    12) echo "$R/bench_tpu_r4_256_r2config.json" ;;
-    13) echo "$R/bench_tpu_r4_256.json" ;;
-    14) echo "$R/bench_tpu_r4_512_c32.json" ;;
-    15) echo "$R/profile_march_512_r4.txt" ;;
-    16) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
-    17) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
-    18) echo "$R/bench_tpu_r4_1024.json" ;;
-    19) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
-    20) echo "$R/novel_view_tpu_r4.json" ;;
-    21) echo "$R/composite_tpu_r4.json" ;;
-    22) echo "$R/scaling_tpu_r4.json" ;;
-    23) echo "$R/profile_frame_tpu_r4.json" ;;
-    24) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
-    25) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
-    26) echo "$R/novel_view_study_tpu_r5.json" ;;
+    6) echo "$R/rank_slab_tpu_r5.json" ;;
+    7) echo "$R/bench_tpu_r4_512_fused.json" ;;
+    8) echo "$R/bench_tpu_r4_512_fstream.json" ;;
+    9) echo "$R/bench_tpu_r4_512_segxla.json" ;;
+    10) echo "$R/bench_tpu_r5_512_xlahist.json" ;;
+    11) echo "$R/bench_tpu_r5_512_bf16.json" ;;
+    12) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
+    13) echo "$R/bench_tpu_r4_256_r2config.json" ;;
+    14) echo "$R/bench_tpu_r4_256.json" ;;
+    15) echo "$R/bench_tpu_r4_512_c32.json" ;;
+    16) echo "$R/profile_march_512_r4.txt" ;;
+    17) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
+    18) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
+    19) echo "$R/bench_tpu_r4_1024.json" ;;
+    20) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
+    21) echo "$R/novel_view_tpu_r4.json" ;;
+    22) echo "$R/composite_tpu_r4.json" ;;
+    23) echo "$R/scaling_tpu_r4.json" ;;
+    24) echo "$R/profile_frame_tpu_r4.json" ;;
+    25) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
+    26) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
+    27) echo "$R/novel_view_study_tpu_r5.json" ;;
   esac
 }
 
-NSTEPS=26
+NSTEPS=27
 MAXFAIL=2
 for i in $(seq 1 900); do
   next=""

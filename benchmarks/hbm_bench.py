@@ -55,6 +55,8 @@ def main():
     if os.environ.get("SITPU_CPU") == "1":
         from scenery_insitu_tpu.utils.backend import pin_cpu_backend
         pin_cpu_backend()
+    from scenery_insitu_tpu.utils.backend import enable_compile_cache
+    enable_compile_cache()
     dev = jax.devices()[0]
     n = int(os.environ.get("SITPU_HBM_BENCH_MB", "512")) * (1 << 20) // 4
     x = jnp.arange(n, dtype=jnp.float32)  # 512 MB by default

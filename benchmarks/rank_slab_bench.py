@@ -66,6 +66,8 @@ def main():
     if os.environ.get("SITPU_CPU") == "1":
         from scenery_insitu_tpu.utils.backend import pin_cpu_backend
         pin_cpu_backend()
+    from scenery_insitu_tpu.utils.backend import enable_compile_cache
+    enable_compile_cache()
     dev = jax.devices()[0]
     grid = int(os.environ.get("SITPU_BENCH_GRID", "512"))
     n = int(os.environ.get("SITPU_BENCH_RANKS", "8"))

@@ -53,12 +53,19 @@ def _scaled(c, scale):
     return c
 
 
-def run_config(n: int, scale: str, frames: int) -> dict:
+def run_config(n: int, scale: str, frames: int,
+               force_ranks: int = 0) -> dict:
     from scenery_insitu_tpu.config import FrameworkConfig
     from scenery_insitu_tpu.runtime.session import InSituSession
     import jax
 
     c = _scaled(CONFIGS[n], scale)
+    if force_ranks:
+        # single-chip hardware captures of the multi-rank configs: the
+        # workload (grid/particles) stays full-scale, only the mesh
+        # shrinks — an honest per-family device number, not Config N's
+        # distributed figure
+        c["ranks"] = force_ranks
     g = c.get("grid", 0)
     volume_vdi = c["kind"] in ("gray_scott", "vortex")
     overrides = [
@@ -110,19 +117,24 @@ def main():
     ap.add_argument("--scale", choices=("small", "full"), default="small")
     ap.add_argument("--timeout", type=int, default=1200,
                     help="per-config subprocess timeout (s)")
+    ap.add_argument("--force-ranks", type=int, default=0,
+                    help="clamp every config's mesh to N ranks (0=off): "
+                    "full-scale single-chip family captures on a 1-chip "
+                    "tunnel")
     args = ap.parse_args()
 
     from scenery_insitu_tpu.utils.backend import probe_tpu, virtual_mesh_env
 
     tpu_devices = probe_tpu()
     for n in (int(x) for x in args.configs.split(",")):
-        ranks = CONFIGS[n]["ranks"]
+        ranks = args.force_ranks or CONFIGS[n]["ranks"]
         if tpu_devices >= ranks:
             env = dict(os.environ)          # real chips
         else:
             env = virtual_mesh_env(max(ranks, 1))
             env["_SITPU_PIN_CPU"] = "1"
-        env[_CHILD] = f"{n},{args.scale},{args.frames}"
+        env[_CHILD] = (f"{n},{args.scale},{args.frames},"
+                       f"{args.force_ranks}")
         try:
             p = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                env=env, timeout=args.timeout,
@@ -148,8 +160,11 @@ if __name__ == "__main__":
         if os.environ.get("_SITPU_PIN_CPU") == "1":
             from scenery_insitu_tpu.utils.backend import pin_cpu_backend
             pin_cpu_backend()
-        n, scale, frames = os.environ[_CHILD].split(",")
-        print(json.dumps(run_config(int(n), scale, int(frames))),
+        parts = os.environ[_CHILD].split(",")
+        n, scale, frames = parts[0], parts[1], parts[2]
+        force = int(parts[3]) if len(parts) > 3 else 0
+        print(json.dumps(run_config(int(n), scale, int(frames),
+                                    force_ranks=force)),
               flush=True)
     else:
         main()

@@ -16,7 +16,7 @@ cd "$(dirname "$0")/.." || exit 1
 mkdir -p benchmarks/results
 R=benchmarks/results
 L=/tmp/tpu_watcher_r5.log
-LAYOUT=r5v7
+LAYOUT=r5v8
 if [ "$(cat /tmp/r5_layout 2>/dev/null)" != "$LAYOUT" ]; then
   rm -f /tmp/r5_fail.*
   echo "$LAYOUT" > /tmp/r5_layout
@@ -138,40 +138,48 @@ run_step() {  # run_step <n>
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # ---- medium steps: profiles and split microbench sweeps ----
     # 11: march-stage profile at 512 (where do the ms go?)
-    16) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
+    # 16: full-scale SINGLE-chip family captures — vortex 256^3, LJ 1M
+    # particles, hybrid 256^3+500k through the real session loop: a
+    # hardware number for every BASELINE model family (their multi-rank
+    # figures need chips this tunnel does not have; workload full-scale,
+    # mesh clamped to 1)
+    16) run_jsonl "$R/configs_full_1chip_tpu_r5.jsonl" 2000 \
+         python benchmarks/configs_bench.py --configs 1,3,4,5 \
+         --scale full --force-ranks 1 --frames 10 --timeout 450 ;;
+    17) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
          python -u benchmarks/profile_march.py 512 ;;
     # 12: fold microbench, core schedules (floors + seg family)
-    17) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
+    18) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants none,count,xla,seg,pallas_seg ;;
     # 13: fold microbench, fused family (+ its controlled baselines)
-    18) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
+    19) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
     # 14: the 1024^3 north-star attempt (diagnosed OOM is also a result)
-    19) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
+    20) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
          SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
          python bench.py ;;
     # ---- the rest of the r4 queue ----
-    20) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
+    21) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
          --variants none,count,xla,seg,pallas_seg,pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
-    21) run_json "$R/novel_view_tpu_r4.json" 1500 \
+    22) run_json "$R/novel_view_tpu_r4.json" 1500 \
          python benchmarks/novel_view_bench.py --iters 3 ;;
-    22) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
+    23) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
          python benchmarks/composite_bench.py ;;
-    23) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
+    24) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
          python benchmarks/scaling_bench.py --grid 128 --frames 10 ;;
-    24) run_json "$R/profile_frame_tpu_r4.json" 1200 \
+    25) run_json "$R/profile_frame_tpu_r4.json" 1200 \
          python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
-    25) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
+    26) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 32 --variants xla,seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    26) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
+    27) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 64 --variants seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    27) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
+    28) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
          SITPU_BENCH_REAL=1 python benchmarks/novel_view_study.py ;;
   esac
 }
@@ -193,22 +201,23 @@ step_out() {
     13) echo "$R/bench_tpu_r4_256_r2config.json" ;;
     14) echo "$R/bench_tpu_r4_256.json" ;;
     15) echo "$R/bench_tpu_r4_512_c32.json" ;;
-    16) echo "$R/profile_march_512_r4.txt" ;;
-    17) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
-    18) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
-    19) echo "$R/bench_tpu_r4_1024.json" ;;
-    20) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
-    21) echo "$R/novel_view_tpu_r4.json" ;;
-    22) echo "$R/composite_tpu_r4.json" ;;
-    23) echo "$R/scaling_tpu_r4.json" ;;
-    24) echo "$R/profile_frame_tpu_r4.json" ;;
-    25) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
-    26) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
-    27) echo "$R/novel_view_study_tpu_r5.json" ;;
+    16) echo "$R/configs_full_1chip_tpu_r5.jsonl" ;;
+    17) echo "$R/profile_march_512_r4.txt" ;;
+    18) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
+    19) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
+    20) echo "$R/bench_tpu_r4_1024.json" ;;
+    21) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
+    22) echo "$R/novel_view_tpu_r4.json" ;;
+    23) echo "$R/composite_tpu_r4.json" ;;
+    24) echo "$R/scaling_tpu_r4.json" ;;
+    25) echo "$R/profile_frame_tpu_r4.json" ;;
+    26) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
+    27) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
+    28) echo "$R/novel_view_study_tpu_r5.json" ;;
   esac
 }
 
-NSTEPS=27
+NSTEPS=28
 MAXFAIL=2
 for i in $(seq 1 900); do
   next=""

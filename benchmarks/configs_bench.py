@@ -64,8 +64,9 @@ def run_config(n: int, scale: str, frames: int,
         # single-chip hardware captures of the multi-rank configs: the
         # workload (grid/particles) stays full-scale, only the mesh
         # shrinks — an honest per-family device number, not Config N's
-        # distributed figure
-        c["ranks"] = force_ranks
+        # distributed figure. Clamp-only: forcing ranks UP would demote
+        # an intended hardware run to the virtual CPU mesh silently.
+        c["ranks"] = min(force_ranks, c["ranks"])
     g = c.get("grid", 0)
     volume_vdi = c["kind"] in ("gray_scott", "vortex")
     overrides = [
@@ -126,8 +127,10 @@ def main():
     from scenery_insitu_tpu.utils.backend import probe_tpu, virtual_mesh_env
 
     tpu_devices = probe_tpu()
+    ok_count = 0
     for n in (int(x) for x in args.configs.split(",")):
-        ranks = args.force_ranks or CONFIGS[n]["ranks"]
+        ranks = (min(args.force_ranks, CONFIGS[n]["ranks"])
+                 if args.force_ranks else CONFIGS[n]["ranks"])
         if tpu_devices >= ranks:
             env = dict(os.environ)          # real chips
         else:
@@ -145,6 +148,8 @@ def main():
                          if l.startswith("{")), None)
             if p.returncode == 0 and line:
                 print(line, flush=True)
+                if '"error"' not in line:
+                    ok_count += 1
             else:
                 print(json.dumps({"metric": f"baseline_config_{n}",
                                   "error": f"rc={p.returncode}",
@@ -153,6 +158,10 @@ def main():
             print(json.dumps({"metric": f"baseline_config_{n}",
                               "error": f"timeout {args.timeout}s"}),
                   flush=True)
+    if ok_count == 0:
+        # all configs failed: a caller treating exit 0 as a done-marker
+        # (the TPU watcher) must retry, not archive an all-error artifact
+        sys.exit(1)
 
 
 if __name__ == "__main__":

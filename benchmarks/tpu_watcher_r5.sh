@@ -61,52 +61,52 @@ run_jsonl() {
 run_step() {  # run_step <n>
   case "$1" in
     # ---- short steps first: one compile + 25 frames each ----
-    # 1: flagship 512^3, default fold (done in window 1: 2.38 fps)
+    # flagship 512^3, default fold (done in window 1: 2.38 fps)
     1) run_json "$R/bench_tpu_r4_512.json" 1000 env \
          SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
          python bench.py ;;
-    # 2: the 30-second micro-roofline — what does THIS chip deliver?
+    # the 30-second micro-roofline — what does THIS chip deliver?
     # copy/axpy/stencil/sim/matmul achieved GB/s + TFLOP/s decides
     # whether "69 GB/s achieved" means "kernels leave 10x on the table"
     # or "the axon chip never delivers data-sheet bandwidth" (in which
     # case every schedule A/B will come back flat, as rounds 3-5 did)
     2) run_json "$R/hbm_micro_tpu_r5.json" 600 \
          python benchmarks/hbm_bench.py ;;
-    # 3: RENDER-ONLY flagship (sim_steps=0, static field, moving camera
+    # RENDER-ONLY flagship (sim_steps=0, static field, moving camera
     # — the reference's own FPS-harness semantics, and the honest
     # in-situ split: its sim runs on CPU nodes while the GPU renders)
     3) run_json "$R/bench_tpu_r5_512_render.json" 900 env \
          SITPU_BENCH_SIM_STEPS=0 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 4: flagship RE-capture after the T-step sim-fusion lever (the
+    # flagship RE-capture after the T-step sim-fusion lever (the
     # step-1 artifact is the pre-fusion baseline; same config otherwise)
     4) run_json "$R/bench_tpu_r5_512_simfused.json" 900 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
          python bench.py ;;
-    # 5: whole-loop-in-one-jit flagship (25 frames via lax.scan, ONE
+    # whole-loop-in-one-jit flagship (25 frames via lax.scan, ONE
     # executable launch) — isolates any per-launch axon dispatch tax
     # from device time (pairs with hbm_bench's dispatch_tiny_us)
     5) run_json "$R/bench_tpu_r5_512_scanloop.json" 900 env \
          SITPU_BENCH_SCAN_FRAMES=1 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 6: BASELINE Config 2 on its own terms — per-rank slab sim/march/
+    # BASELINE Config 2 on its own terms — per-rank slab sim/march/
     # composite MEASURED (real distributed geometry + shapes), ICI a2a
     # modeled with stated bandwidth: the honest v5e-8 projection
     6) run_json "$R/rank_slab_tpu_r5.json" 900 \
          python benchmarks/rank_slab_bench.py ;;
-    # 7: fused shade+fold kernel (rgba/depth streams never hit HBM)
+    # fused shade+fold kernel (rgba/depth streams never hit HBM)
     7) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
          SITPU_BENCH_FOLD=pallas_fused SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 4: whole-march stream fold ([K] state crosses HBM once per march)
+    # whole-march stream fold ([K] state crosses HBM once per march)
     8) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
          SITPU_BENCH_FOLD=fused_stream SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 5: pure-XLA seg fold (Mosaic-free A/B)
+    # pure-XLA seg fold (Mosaic-free A/B)
     9) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 9: the missing cell of the (fold x mode) matrix at 512: round 2's
+    # the missing cell of the (fold x mode) matrix at 512: round 2's
     # 256^3 winner {xla fold, histogram} — at 256 it did TWO marches in
     # 29 ms while {pallas, temporal} did ONE in 49 ms, contradicting the
     # synthetic-stream microbench; this tests whether the frame-context
@@ -115,30 +115,30 @@ run_step() {  # run_step <n>
          SITPU_BENCH_FOLD=xla SITPU_BENCH_ADAPTIVE_MODE=histogram \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
          python bench.py ;;
-    # 10: bf16 RENDER copy — the HBM-traffic lever (matmuls already bf16)
+    # bf16 RENDER copy — the HBM-traffic lever (matmuls already bf16)
     11) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
          SITPU_BENCH_RENDER_DTYPE=bf16 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 7: in-plane occupancy v-tiles
+    # in-plane occupancy v-tiles
     12) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
          SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 8: 256^3 exact round-2 config A/B (the regression attribution)
+    # 256^3 exact round-2 config A/B (the regression attribution)
     13) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
          SITPU_BENCH_GRID=256 SITPU_BENCH_ADAPTIVE_MODE=histogram \
          SITPU_BENCH_FOLD=xla SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 9: 256^3 round-default (temporal + seg fold)
+    # 256^3 round-default (temporal + seg fold)
     14) run_json "$R/bench_tpu_r4_256.json" 900 env \
          SITPU_BENCH_GRID=256 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # 10: flagship at chunk 32
+    # flagship at chunk 32
     15) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
          SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # ---- medium steps: profiles and split microbench sweeps ----
-    # 11: march-stage profile at 512 (where do the ms go?)
-    # 16: full-scale SINGLE-chip family captures — vortex 256^3, LJ 1M
+    # march-stage profile at 512 (where do the ms go?)
+    # full-scale SINGLE-chip family captures — vortex 256^3, LJ 1M
     # particles, hybrid 256^3+500k through the real session loop: a
     # hardware number for every BASELINE model family (their multi-rank
     # figures need chips this tunnel does not have; workload full-scale,
@@ -148,15 +148,15 @@ run_step() {  # run_step <n>
          --scale full --force-ranks 1 --frames 10 --timeout 450 ;;
     17) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
          python -u benchmarks/profile_march.py 512 ;;
-    # 12: fold microbench, core schedules (floors + seg family)
+    # fold microbench, core schedules (floors + seg family)
     18) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants none,count,xla,seg,pallas_seg ;;
-    # 13: fold microbench, fused family (+ its controlled baselines)
+    # fold microbench, fused family (+ its controlled baselines)
     19) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
-    # 14: the 1024^3 north-star attempt (diagnosed OOM is also a result)
+    # the 1024^3 north-star attempt (diagnosed OOM is also a result)
     20) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
          SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \

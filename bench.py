@@ -30,6 +30,12 @@ BASELINE primary scale 512^3 x 25 frames; the CPU fallback drops to
   SITPU_BENCH_FOLD=auto|pallas_seg|seg|pallas|xla  (auto = pallas_seg on
     TPU, probe-gated; see config.SliceMarchConfig.fold for the schedules)
   SITPU_BENCH_PLATFORMS=tpu,tpu,cpu  SITPU_BENCH_CHILD_TIMEOUT=900
+  SITPU_BENCH_AUTOTUNE=1|0  (default ON for TPU temporal runs at
+    grid<=512 with no explicit FOLD: times 2 frames each of
+    auto/fused_stream/xla at warmup and benches the winner — set 0, or
+    set SITPU_BENCH_FOLD, for fixed-fold A/B captures)
+  SITPU_BENCH_SCAN_FRAMES=1  (whole frame loop in ONE lax.scan launch)
+  SITPU_BENCH_SIM_STEPS=0    (render-only: static field, moving camera)
 The second consecutive tpu attempt falls back to SITPU_BENCH_FOLD=seg
 (the same segmented-scan fold without Mosaic exposure) — but only if a
 TPU child actually ran and died, so a probe-level tunnel flap never
@@ -205,22 +211,76 @@ def main():
         ad_mode = "histogram"
 
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
-    march_cfg = SliceMarchConfig(fold=fold, chunk=chunk,
-                                 occupancy_vtiles=vtiles)
-    frame_step = grayscott_vdi_frame_step(
-        width, height, sim_steps=sim_steps, max_steps=steps,
-        vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,
-                          adaptive_mode=ad_mode),
-        comp_cfg=CompositeConfig(max_output_supersegments=k,
-                                 adaptive_iters=ad_iters),
-        engine=engine, grid_shape=(grid, grid, grid),
-        axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
-        slicer_cfg=march_cfg, render_dtype=render_dtype)
+
+    def make_step(fold_name):
+        mc = SliceMarchConfig(fold=fold_name, chunk=chunk,
+                              occupancy_vtiles=vtiles)
+        return mc, grayscott_vdi_frame_step(
+            width, height, sim_steps=sim_steps, max_steps=steps,
+            vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,
+                              adaptive_mode=ad_mode),
+            comp_cfg=CompositeConfig(max_output_supersegments=k,
+                                     adaptive_iters=ad_iters),
+            engine=engine, grid_shape=(grid, grid, grid),
+            axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
+            slicer_cfg=mc, render_dtype=render_dtype)
 
     # the mxu step is compiled for the base camera's march regime (axis z
     # here); oscillate the orbit within ±0.35 rad so every benched frame
     # stays inside that regime no matter how many frames are requested
     temporal = ad_mode == "temporal" and engine == "mxu"
+
+    # warmup-time fold AUTOTUNE (TPU default; SITPU_BENCH_AUTOTUNE=0 or an
+    # explicit SITPU_BENCH_FOLD disables): the fold-schedule ranking has
+    # disagreed with the synthetic microbench across rounds, and tunnel
+    # windows are too scarce to guess — so when the hardware IS there,
+    # measure 2 frames per candidate and bench the winner. Candidates:
+    # the platform default, the whole-march stream fold, and the
+    # fuses-into-the-march XLA fold (the round-2 256^3 frame-context
+    # winner). Per-candidate guarded; compile cache makes repeats cheap.
+    # gated to <=512 grids: the tuning jits are NOT donated (each timed
+    # call holds input + output sim copies), which is fine at 512^3
+    # (~1 GB extra) but would OOM the 1024^3 memory plan before the
+    # donated main loop even runs
+    autotune = _env_int("SITPU_BENCH_AUTOTUNE",
+                        1 if (on_tpu and grid <= 512) else 0)
+    autotune_ms = None
+    st0 = None
+    if (autotune and temporal and grid <= 512
+            and "SITPU_BENCH_FOLD" not in os.environ):
+        st0 = gs.GrayScott.init((grid, grid, grid))
+        autotune_ms = {}
+        thr0 = None
+        for fname in ("auto", "fused_stream", "xla"):
+            try:
+                _, fs = make_step(fname)
+                fr = jax.jit(lambda u_, v_, yaw, th, fs=fs:
+                             fs(u_, v_, orbit(base, yaw).eye, th))
+                # (not donated: st0 must survive for the main loop)
+                if thr0 is None:
+                    thr0 = jax.jit(fs.init_threshold)(st0.u, st0.v,
+                                                      base.eye)
+                c2, d2, u2, v2, t2 = fr(st0.u, st0.v, jnp.float32(0.0),
+                                        thr0)
+                jax.block_until_ready(c2)          # compile + settle
+                t0 = time.perf_counter()
+                for _ in range(2):
+                    c2, d2, u2, v2, t2 = fr(u2, v2, jnp.float32(0.01), t2)
+                jax.block_until_ready(c2)
+                autotune_ms[fname] = round(
+                    (time.perf_counter() - t0) / 2 * 1e3, 1)
+            except Exception as e:
+                autotune_ms[fname] = f"error: {type(e).__name__}"
+            finally:
+                fr = fs = c2 = d2 = u2 = v2 = t2 = None
+        timed = {f: m for f, m in autotune_ms.items()
+                 if isinstance(m, float)}
+        if timed:
+            fold = min(timed, key=timed.get)
+            print(f"[bench] autotune {autotune_ms} -> fold={fold}",
+                  file=sys.stderr, flush=True)
+
+    march_cfg, frame_step = make_step(fold)
     if temporal:
         def frame(u, v, yaw, thr):
             return frame_step(u, v, orbit(base, yaw).eye, thr)
@@ -231,7 +291,7 @@ def main():
     # donate the carried sim/threshold state: at the 512^3 primary scale
     # u+v alone are 1 GB — without donation every frame holds two copies
     frame = jax.jit(frame, donate_argnums=(0, 1, 3) if temporal else (0, 1))
-    st = gs.GrayScott.init((grid, grid, grid))
+    st = st0 or gs.GrayScott.init((grid, grid, grid))
     u, v = st.u, st.v
 
     # warmup / compile (temporal: seed the threshold state + 2 settle
@@ -369,6 +429,7 @@ def main():
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
                    "chunk": chunk, "scan_frames": bool(scan_frames),
+                   "autotune_ms": autotune_ms,
                    "compile_s": round(compile_s, 1),
                    "platform": platform, "device": dev.device_kind,
                    "assumed_peak_tflops": (peak / 1e12 if peak else None),

@@ -16,7 +16,7 @@ cd "$(dirname "$0")/.." || exit 1
 mkdir -p benchmarks/results
 R=benchmarks/results
 L=/tmp/tpu_watcher_r5.log
-LAYOUT=r5v8
+LAYOUT=r5v9
 if [ "$(cat /tmp/r5_layout 2>/dev/null)" != "$LAYOUT" ]; then
   rm -f /tmp/r5_fail.*
   echo "$LAYOUT" > /tmp/r5_layout
@@ -63,7 +63,7 @@ run_step() {  # run_step <n>
     # ---- short steps first: one compile + 25 frames each ----
     # flagship 512^3, default fold (done in window 1: 2.38 fps)
     1) run_json "$R/bench_tpu_r4_512.json" 1000 env \
-         SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_PLATFORMS=tpu,tpu SITPU_BENCH_CHILD_TIMEOUT=420 \
          python bench.py ;;
     # the 30-second micro-roofline — what does THIS chip deliver?
     # copy/axpy/stencil/sim/matmul achieved GB/s + TFLOP/s decides
@@ -76,34 +76,40 @@ run_step() {  # run_step <n>
     # — the reference's own FPS-harness semantics, and the honest
     # in-situ split: its sim runs on CPU nodes while the GPU renders)
     3) run_json "$R/bench_tpu_r5_512_render.json" 900 env \
-         SITPU_BENCH_SIM_STEPS=0 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_SIM_STEPS=0 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # flagship RE-capture after the T-step sim-fusion lever (the
     # step-1 artifact is the pre-fusion baseline; same config otherwise)
     4) run_json "$R/bench_tpu_r5_512_simfused.json" 900 env \
-         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
-         python bench.py ;;
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
+    # AUTOTUNED flagship: warmup times auto/fused_stream/xla for 2
+    # frames each and benches the winner (the best-of capture; the
+    # fixed-fold steps above/below stay single-variable A/Bs)
+    5) run_json "$R/bench_tpu_r5_512_autotuned.json" 1000 env \
+         SITPU_BENCH_AUTOTUNE=1 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=850 python bench.py ;;
     # whole-loop-in-one-jit flagship (25 frames via lax.scan, ONE
     # executable launch) — isolates any per-launch axon dispatch tax
     # from device time (pairs with hbm_bench's dispatch_tiny_us)
-    5) run_json "$R/bench_tpu_r5_512_scanloop.json" 900 env \
-         SITPU_BENCH_SCAN_FRAMES=1 SITPU_BENCH_PLATFORMS=tpu \
+    6) run_json "$R/bench_tpu_r5_512_scanloop.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_SCAN_FRAMES=1 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # BASELINE Config 2 on its own terms — per-rank slab sim/march/
     # composite MEASURED (real distributed geometry + shapes), ICI a2a
     # modeled with stated bandwidth: the honest v5e-8 projection
-    6) run_json "$R/rank_slab_tpu_r5.json" 900 \
+    7) run_json "$R/rank_slab_tpu_r5.json" 900 \
          python benchmarks/rank_slab_bench.py ;;
     # fused shade+fold kernel (rgba/depth streams never hit HBM)
-    7) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
+    8) run_json "$R/bench_tpu_r4_512_fused.json" 900 env \
          SITPU_BENCH_FOLD=pallas_fused SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # whole-march stream fold ([K] state crosses HBM once per march)
-    8) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
+    9) run_json "$R/bench_tpu_r4_512_fstream.json" 900 env \
          SITPU_BENCH_FOLD=fused_stream SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # pure-XLA seg fold (Mosaic-free A/B)
-    9) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
+    10) run_json "$R/bench_tpu_r4_512_segxla.json" 900 env \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_FOLD=seg \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # the missing cell of the (fold x mode) matrix at 512: round 2's
@@ -111,30 +117,30 @@ run_step() {  # run_step <n>
     # 29 ms while {pallas, temporal} did ONE in 49 ms, contradicting the
     # synthetic-stream microbench; this tests whether the frame-context
     # XLA fold wins at the flagship scale too
-    10) run_json "$R/bench_tpu_r5_512_xlahist.json" 900 env \
+    11) run_json "$R/bench_tpu_r5_512_xlahist.json" 900 env \
          SITPU_BENCH_FOLD=xla SITPU_BENCH_ADAPTIVE_MODE=histogram \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
          python bench.py ;;
     # bf16 RENDER copy — the HBM-traffic lever (matmuls already bf16)
-    11) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
-         SITPU_BENCH_RENDER_DTYPE=bf16 SITPU_BENCH_PLATFORMS=tpu \
+    12) run_json "$R/bench_tpu_r5_512_bf16.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_RENDER_DTYPE=bf16 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # in-plane occupancy v-tiles
-    12) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
-         SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
+    13) run_json "$R/bench_tpu_r4_512_vtiles8.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_VTILES=8 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 256^3 exact round-2 config A/B (the regression attribution)
-    13) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
+    14) run_json "$R/bench_tpu_r4_256_r2config.json" 900 env \
          SITPU_BENCH_GRID=256 SITPU_BENCH_ADAPTIVE_MODE=histogram \
          SITPU_BENCH_FOLD=xla SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # 256^3 round-default (temporal + seg fold)
-    14) run_json "$R/bench_tpu_r4_256.json" 900 env \
-         SITPU_BENCH_GRID=256 SITPU_BENCH_PLATFORMS=tpu \
+    15) run_json "$R/bench_tpu_r4_256.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_GRID=256 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # flagship at chunk 32
-    15) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
-         SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
+    16) run_json "$R/bench_tpu_r4_512_c32.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_CHUNK=32 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
     # ---- medium steps: profiles and split microbench sweeps ----
     # march-stage profile at 512 (where do the ms go?)
@@ -143,43 +149,43 @@ run_step() {  # run_step <n>
     # hardware number for every BASELINE model family (their multi-rank
     # figures need chips this tunnel does not have; workload full-scale,
     # mesh clamped to 1)
-    16) run_jsonl "$R/configs_full_1chip_tpu_r5.jsonl" 2000 \
+    17) run_jsonl "$R/configs_full_1chip_tpu_r5.jsonl" 2000 \
          python benchmarks/configs_bench.py --configs 1,3,4,5 \
          --scale full --force-ranks 1 --frames 10 --timeout 450 ;;
-    17) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
+    18) run_jsonl "$R/profile_march_512_r4.txt" 1800 \
          python -u benchmarks/profile_march.py 512 ;;
     # fold microbench, core schedules (floors + seg family)
-    18) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
+    19) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants none,count,xla,seg,pallas_seg ;;
     # fold microbench, fused family (+ its controlled baselines)
-    19) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
+    20) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --variants pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
     # the 1024^3 north-star attempt (diagnosed OOM is also a result)
-    20) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
-         SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
+    21) run_json "$R/bench_tpu_r4_1024.json" 2100 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_GRID=1024 SITPU_BENCH_FRAMES=5 \
          SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=1800 \
          python bench.py ;;
     # ---- the rest of the r4 queue ----
-    21) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
+    22) run_jsonl "$R/fold_microbench_256_seg_r4.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 256 --iters 5 --check \
          --variants none,count,xla,seg,pallas_seg,pallas,fused,fused_stream,tf_pallas_seg,tf_xla_seg ;;
-    22) run_json "$R/novel_view_tpu_r4.json" 1500 \
+    23) run_json "$R/novel_view_tpu_r4.json" 1500 \
          python benchmarks/novel_view_bench.py --iters 3 ;;
-    23) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
+    24) run_json "$R/composite_tpu_r4.json" 1200 env SITPU_BENCH_REAL=1 \
          python benchmarks/composite_bench.py ;;
-    24) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
+    25) run_json "$R/scaling_tpu_r4.json" 1800 env SITPU_BENCH_REAL=1 \
          python benchmarks/scaling_bench.py --grid 128 --frames 10 ;;
-    25) run_json "$R/profile_frame_tpu_r4.json" 1200 \
+    26) run_json "$R/profile_frame_tpu_r4.json" 1200 \
          python benchmarks/profile_frame.py --out "$R/trace_r4" ;;
-    26) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
+    27) run_jsonl "$R/fold_microbench_512_c32_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 32 --variants xla,seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    27) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
+    28) run_jsonl "$R/fold_microbench_512_c64_seg_r4.jsonl" 1800 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
          --chunk 64 --variants seg,pallas_seg,fused,fused_stream,tf_xla_seg ;;
-    28) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
+    29) run_json "$R/novel_view_study_tpu_r5.json" 1200 env \
          SITPU_BENCH_REAL=1 python benchmarks/novel_view_study.py ;;
   esac
 }
@@ -190,34 +196,35 @@ step_out() {
     2) echo "$R/hbm_micro_tpu_r5.json" ;;
     3) echo "$R/bench_tpu_r5_512_render.json" ;;
     4) echo "$R/bench_tpu_r5_512_simfused.json" ;;
-    5) echo "$R/bench_tpu_r5_512_scanloop.json" ;;
-    6) echo "$R/rank_slab_tpu_r5.json" ;;
-    7) echo "$R/bench_tpu_r4_512_fused.json" ;;
-    8) echo "$R/bench_tpu_r4_512_fstream.json" ;;
-    9) echo "$R/bench_tpu_r4_512_segxla.json" ;;
-    10) echo "$R/bench_tpu_r5_512_xlahist.json" ;;
-    11) echo "$R/bench_tpu_r5_512_bf16.json" ;;
-    12) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
-    13) echo "$R/bench_tpu_r4_256_r2config.json" ;;
-    14) echo "$R/bench_tpu_r4_256.json" ;;
-    15) echo "$R/bench_tpu_r4_512_c32.json" ;;
-    16) echo "$R/configs_full_1chip_tpu_r5.jsonl" ;;
-    17) echo "$R/profile_march_512_r4.txt" ;;
-    18) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
-    19) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
-    20) echo "$R/bench_tpu_r4_1024.json" ;;
-    21) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
-    22) echo "$R/novel_view_tpu_r4.json" ;;
-    23) echo "$R/composite_tpu_r4.json" ;;
-    24) echo "$R/scaling_tpu_r4.json" ;;
-    25) echo "$R/profile_frame_tpu_r4.json" ;;
-    26) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
-    27) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
-    28) echo "$R/novel_view_study_tpu_r5.json" ;;
+    5) echo "$R/bench_tpu_r5_512_autotuned.json" ;;
+    6) echo "$R/bench_tpu_r5_512_scanloop.json" ;;
+    7) echo "$R/rank_slab_tpu_r5.json" ;;
+    8) echo "$R/bench_tpu_r4_512_fused.json" ;;
+    9) echo "$R/bench_tpu_r4_512_fstream.json" ;;
+    10) echo "$R/bench_tpu_r4_512_segxla.json" ;;
+    11) echo "$R/bench_tpu_r5_512_xlahist.json" ;;
+    12) echo "$R/bench_tpu_r5_512_bf16.json" ;;
+    13) echo "$R/bench_tpu_r4_512_vtiles8.json" ;;
+    14) echo "$R/bench_tpu_r4_256_r2config.json" ;;
+    15) echo "$R/bench_tpu_r4_256.json" ;;
+    16) echo "$R/bench_tpu_r4_512_c32.json" ;;
+    17) echo "$R/configs_full_1chip_tpu_r5.jsonl" ;;
+    18) echo "$R/profile_march_512_r4.txt" ;;
+    19) echo "$R/fold_microbench_512_core_r5.jsonl" ;;
+    20) echo "$R/fold_microbench_512_fused_r5.jsonl" ;;
+    21) echo "$R/bench_tpu_r4_1024.json" ;;
+    22) echo "$R/fold_microbench_256_seg_r4.jsonl" ;;
+    23) echo "$R/novel_view_tpu_r4.json" ;;
+    24) echo "$R/composite_tpu_r4.json" ;;
+    25) echo "$R/scaling_tpu_r4.json" ;;
+    26) echo "$R/profile_frame_tpu_r4.json" ;;
+    27) echo "$R/fold_microbench_512_c32_seg_r4.jsonl" ;;
+    28) echo "$R/fold_microbench_512_c64_seg_r4.jsonl" ;;
+    29) echo "$R/novel_view_study_tpu_r5.json" ;;
   esac
 }
 
-NSTEPS=28
+NSTEPS=29
 MAXFAIL=2
 for i in $(seq 1 900); do
   next=""

@@ -226,7 +226,20 @@ step_out() {
 
 NSTEPS=29
 MAXFAIL=2
+# Hard deadline (epoch s): the driver runs its own bench.py at the round
+# boundary (~20:28 UTC), and a watcher step holding the single-chip
+# grant would starve that capture into a CPU fallback (window-1
+# evidence: probes hang while another process holds the chip). The
+# check runs between steps, so the default leaves room for the longest
+# step budget (2400 s): 19:40 + 40 min < 20:28. Override via
+# SITPU_WATCHER_DEADLINE.
+DEADLINE=${SITPU_WATCHER_DEADLINE:-$(date -u -d "today 19:40" +%s)}
 for i in $(seq 1 900); do
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "deadline reached, exiting so the driver owns the chip $(date -u)" \
+      >> "$L"
+    exit 0
+  fi
   next=""
   for s in $(seq 1 $NSTEPS); do
     fails=$(cat "/tmp/r5_fail.$s" 2>/dev/null || echo 0)

@@ -92,3 +92,36 @@ def test_bench_scanloop_render_only_modes():
     assert d["config"]["sim_steps"] == 0
     # render-only is not the sim-in-loop primary config: vs_baseline null
     assert d["vs_baseline"] is None
+
+
+def test_hbm_and_rank_slab_harnesses_emit_json():
+    """The round-5 diagnostic harnesses (micro-roofline, Config-2
+    per-rank projection) are first-in-queue for scarce hardware windows;
+    a silent breakage would burn one. Tiny-shape CPU smoke of both."""
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": _ROOT, "SITPU_CPU": "1",
+                "SITPU_HBM_BENCH_MB": "8", "SITPU_HBM_BENCH_GRID": "32"})
+    p = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks/hbm_bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads([l for l in p.stdout.strip().splitlines()
+                    if l.startswith("{")][-1])
+    for key in ("copy_gbps", "sim10_ms", "dispatch_tiny_us",
+                "dispatch_chain_us", "matmul_tflops"):
+        assert key in d and d[key] is not None, (key, d)
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": _ROOT, "SITPU_CPU": "1",
+                "SITPU_BENCH_GRID": "32", "SITPU_BENCH_RANKS": "4",
+                "SITPU_BENCH_SIM_STEPS": "1", "SITPU_BENCH_K": "4"})
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(_ROOT, "benchmarks/rank_slab_bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads([l for l in p.stdout.strip().splitlines()
+                    if l.startswith("{")][-1])
+    assert d["projected_fps_v5e8"] > 0
+    assert d["per_rank_march_ms"] > 0
+    assert d["a2a_assumed_gbps"] > 0    # the stated-assumption contract

@@ -26,6 +26,11 @@ def timeit(fn, *args, n=3, label=""):
 
 
 def main():
+    if os.environ.get("SITPU_CPU") == "1":
+        # JAX_PLATFORMS=cpu alone does not stop the axon shim's hang on a
+        # dead tunnel — same pin every other harness uses
+        from scenery_insitu_tpu.utils.backend import pin_cpu_backend
+        pin_cpu_backend()
     from scenery_insitu_tpu.config import (CompositeConfig, SliceMarchConfig,
                                            VDIConfig)
     from scenery_insitu_tpu.core.camera import Camera

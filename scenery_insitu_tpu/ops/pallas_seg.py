@@ -50,7 +50,7 @@ _NSMALL = 5
 # Mosaic compiles) is identical for every K <= _EST_K and matches the
 # compile probe's geometry. The floor actually applied lives in
 # pallas_march (strip_fpp uses it); alias it so the two can never diverge.
-from scenery_insitu_tpu.ops.pallas_march import _EST_K  # noqa: F401
+from scenery_insitu_tpu.ops.pallas_march import _EST_K, strip_fpp  # noqa: F401
 
 
 def init_seg_packed(k: int, height: int, width: int):
@@ -110,17 +110,15 @@ def _phase_b(ev_slot, ev_rgba, t0_of, t1_of, ci_, di_, co, do_,
     jax.lax.fori_loop(0, max_k, slot_body, 0)
 
 
-def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
-                co, do_, smo, ev_ref, *, max_k: int):
+def _phase_a(rgba_ref, thr, smi_, smo, ev_ref, kf):
+    """Per-slice (slot, v) records from the shaded rgba stream; 4 small
+    live carries. Shared by the plane-depth and compact-depth kernels."""
     nc = rgba_ref.shape[0]
-    thr = thr_ref[...]
     sm = smi_[...]
     run_cnt = sm[_CNT]
     pr = sm[_PREV_RGB]
     pe = sm[_PREV_EMPTY] > 0.5
-    kf = jnp.float32(max_k - 1)
 
-    # ---- phase A: per-slice records, 4 small live carries
     t_run = jnp.ones_like(thr)
     for s in range(nc):
         rgba = rgba_ref[s]
@@ -142,6 +140,12 @@ def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
     smo[...] = jnp.concatenate([
         run_cnt[None], pr, pe.astype(jnp.float32)[None]])
 
+
+def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
+                co, do_, smo, ev_ref, *, max_k: int):
+    thr = thr_ref[...]
+    _phase_a(rgba_ref, thr, smi_, smo, ev_ref, jnp.float32(max_k - 1))
+
     # ---- phase B: rolled K loop, state touched once per chunk
     ev = ev_ref[...]                                       # [C, 5, TH, WB]
     _phase_b(ev[:, 0], ev[:, 1:5],
@@ -150,28 +154,54 @@ def _seg_kernel(rgba_ref, td_ref, thr_ref, ci_, di_, smi_,
              ci_, di_, co, do_, max_k)
 
 
-def _floats_per_px(c: int, k: int) -> int:
-    """Strip VMEM estimate per pixel column — the shared budget formula
-    with this kernel's deltas: 5 small rows, no count plane (cnt lives
-    in small), 5-float per-slice (slot, v) records."""
-    from scenery_insitu_tpu.ops.pallas_march import strip_fpp
+def _seg_kernel_compact(rgba_ref, len_ref, thr_ref, sk0_ref, sk1_ref,
+                        ci_, di_, smi_, co, do_, smo, ev_ref, *,
+                        max_k: int):
+    """_seg_kernel with the depth planes computed IN-KERNEL from the
+    per-slice ratios and the per-pixel ray length (t = sk * length —
+    exactly what the march's outer product materialized): the [C,2,H,W]
+    td stream never exists in HBM, the march's biggest remaining stream
+    term after rgba (~3.4 GB/march at the 512³ flagship)."""
+    thr = thr_ref[...]
+    _phase_a(rgba_ref, thr, smi_, smo, ev_ref, jnp.float32(max_k - 1))
 
-    return strip_fpp(c, k, small_rows=_NSMALL, count_plane=False,
-                     per_slice_records=5)
+    ev = ev_ref[...]                                       # [C, 5, TH, WB]
+    ln = len_ref[...]                                      # [TH, WB]
+    t0a = sk0_ref[...] * ln[None]                          # [C, TH, WB]
+    t1a = sk1_ref[...] * ln[None]
+    _phase_b(ev[:, 0], ev[:, 1:5],
+             lambda m: jnp.where(m, t0a, jnp.inf),
+             lambda m: jnp.where(m, t1a, -jnp.inf),
+             ci_, di_, co, do_, max_k)
 
 
-def fold_chunk_packed(packed, rgba: jnp.ndarray, t0: jnp.ndarray,
-                      t1: jnp.ndarray, threshold: jnp.ndarray, *,
-                      max_k: int, interpret: Optional[bool] = None):
+def fold_chunk_packed(packed, rgba: jnp.ndarray, t0=None, t1=None,
+                      threshold: jnp.ndarray = None, *, max_k: int,
+                      interpret: Optional[bool] = None,
+                      sk0=None, sk1=None, length=None):
     """Fold one chunk on VMEM pixel strips, packed-state in/out.
 
     ``packed`` is the `init_seg_packed` triple; carrying it through the
     march's scan keeps the [K,...] state layout stable across chunks so
     ``input_output_aliases`` updates it in place — no per-chunk
     stack/slice re-materialization. Semantics = seg_fold.seg_fold_chunk.
+
+    Depth comes in one of two forms:
+    - ``t0``/``t1`` f32[C,H,W] planes (tests / arbitrary streams), or
+    - COMPACT: ``sk0``/``sk1`` f32[C] per-slice ratios + ``length``
+      f32[H,W] — the kernel computes t = sk*length itself, so the
+      [C,2,H,W] depth stream never exists in HBM (the production march
+      path; its t0/t1 are exactly this outer product).
     """
     if interpret is None:
         interpret = should_interpret()
+    compact = sk0 is not None
+    planes_full = t0 is not None and t1 is not None
+    compact_full = (sk0 is not None and sk1 is not None
+                    and length is not None)
+    if planes_full == compact_full or not (planes_full or compact_full):
+        raise ValueError("pass exactly one COMPLETE depth form: "
+                         "(t0, t1) or (sk0, sk1, length)")
     color, depth, small = packed
     kk = color.shape[0]
     _, _, h, w = color.shape
@@ -179,13 +209,39 @@ def fold_chunk_packed(packed, rgba: jnp.ndarray, t0: jnp.ndarray,
     if h % TILE_H:
         raise ValueError(f"height {h} not a multiple of {TILE_H}")
     threshold = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), (h, w))
-    td = jnp.stack([t0, t1], axis=1)                       # [C, 2, H, W]
 
-    wb = _pick_block_w(w, 4 * TILE_H * _floats_per_px(c, kk))
+    # compact: the rgba stream shrinks 6C->4C and gains 1 length plane,
+    # but the kernel broadcasts its own t0a/t1a [C,TH,WB] temporaries —
+    # counted in per_slice_records exactly as _fused_fpp documents
+    fpp = strip_fpp(c, kk, small_rows=_NSMALL, count_plane=False,
+                    per_slice_records=7 if compact else 5,
+                    stream_per_slice=4 if compact else 6,
+                    extra_planes=1 if compact else 0)
+    wb = _pick_block_w(w, 4 * TILE_H * fpp)
     grid = (h // TILE_H, pl.cdiv(w, wb))
     row = lambda *lead: pl.BlockSpec(lead + (TILE_H, wb),
                                      lambda j, i: (0,) * len(lead) + (j, i))
     state_specs = [row(kk, 4), row(kk, 2), row(_NSMALL)]
+    if compact:
+        length = jnp.broadcast_to(jnp.asarray(length, jnp.float32), (h, w))
+        sk0 = jnp.asarray(sk0, jnp.float32).reshape(c, 1, 1)
+        sk1 = jnp.asarray(sk1, jnp.float32).reshape(c, 1, 1)
+        sk_spec = pl.BlockSpec((c, 1, 1), lambda j, i: (0, 0, 0))
+        out = pl.pallas_call(
+            functools.partial(_seg_kernel_compact, max_k=max_k),
+            grid=grid,
+            in_specs=[row(c, 4), row(), row(), sk_spec, sk_spec]
+            + state_specs,
+            out_specs=state_specs,
+            out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype)
+                       for p in packed],
+            scratch_shapes=[pltpu.VMEM((c, 5, TILE_H, wb), jnp.float32)],
+            input_output_aliases={5: 0, 6: 1, 7: 2},
+            interpret=interpret,
+        )(rgba, length, threshold, sk0, sk1, *packed)
+        return tuple(out)
+
+    td = jnp.stack([t0, t1], axis=1)                       # [C, 2, H, W]
     out = pl.pallas_call(
         functools.partial(_seg_kernel, max_k=max_k),
         grid=grid,
@@ -481,9 +537,24 @@ def seg_compile_ok(max_k: int = 32, chunk: int = 16,
             k, c, h, w = int(max_k), int(chunk), TILE_H, int(width)
             sds = jax.ShapeDtypeStruct
 
-            def f(st, rgba, t0, t1, thr):
+            # probe BOTH kernel variants the production march can trace:
+            # the compact-depth form (what the march feeds) and the
+            # td-plane form (tests / arbitrary streams)
+            def f(pk, rgba, sk, ln, thr):
+                return fold_chunk_packed(pk, rgba, threshold=thr,
+                                         max_k=k, sk0=sk, sk1=sk,
+                                         length=ln)
+
+            def g(st, rgba, t0, t1, thr):
                 return seg_fold_chunk(st, rgba, t0, t1, thr, max_k=k)
 
+            pk = (sds((k, 4, h, w), jnp.float32),
+                  sds((k, 2, h, w), jnp.float32),
+                  sds((_NSMALL, h, w), jnp.float32))
+            jax.jit(f).lower(
+                pk, sds((c, 4, h, w), jnp.float32),
+                sds((c,), jnp.float32), sds((h, w), jnp.float32),
+                sds((h, w), jnp.float32)).compile()
             st = sf.SegFoldState(
                 out_color=sds((k, 4, h, w), jnp.float32),
                 out_start=sds((k, h, w), jnp.float32),
@@ -491,7 +562,7 @@ def seg_compile_ok(max_k: int = 32, chunk: int = 16,
                 cnt=sds((h, w), jnp.int32),
                 prev_rgb=sds((3, h, w), jnp.float32),
                 prev_empty=sds((h, w), jnp.bool_))
-            jax.jit(f).lower(
+            jax.jit(g).lower(
                 st, sds((c, 4, h, w), jnp.float32),
                 sds((c, h, w), jnp.float32), sds((c, h, w), jnp.float32),
                 sds((h, w), jnp.float32)).compile()

@@ -524,7 +524,8 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 u_bounds=None, v_bounds=None, step_scale: float = 1.0,
                 occupancy: Optional[jnp.ndarray] = None,
                 early_stop: Optional[Callable] = None, raw: bool = False,
-                raw_full_skip: bool = False):
+                raw_full_skip: bool = False,
+                shaded_compact: bool = False):
     """The chunked slice march. Calls ``consume(carry, rgba [C,4,Nj,Ni],
     t0 [C,Nj,Ni], t1 [C,Nj,Ni]) -> carry`` for each chunk of slices, front
     to back, and returns the final carry.
@@ -556,6 +557,15 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     no opacity correction, no t0/t1 streams. This is the fused-kernel
     feed (ops/pallas_seg.fused_fold_chunk shades in-kernel); scalar
     volumes only.
+
+    ``shaded_compact=True`` keeps the full shading (premultiplied,
+    opacity-corrected rgba) but replaces the depth planes with the
+    per-slice ratios: ``consume(carry, rgba [C,4,Nj,Ni], sk0 [C],
+    sk1 [C]) -> carry`` where the plane path's t0/t1 are exactly
+    ``sk0*length`` / ``sk1*length`` (length = axcam.ray_lengths()).
+    Occupancy-skipped iterations feed a C=1 all-empty chunk, like the
+    default contract. This is the compact pallas_seg feed — the
+    [C,2,Nj,Ni] depth planes never materialize in HBM.
     """
     pre_shaded = vol.data.ndim == 4
     if raw and pre_shaded:
@@ -694,6 +704,13 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 
         if raw:
             return consume(carry, rgba, sk)
+        if shaded_compact:
+            # compact contract: shaded rgba + BOTH per-slice depth ratios
+            # (sk0, sk1 = sk + ds) so the step geometry stays defined in
+            # ONE place; the consumer owns only t = sk*length (in-kernel
+            # for the compact pallas_seg fold — the [C,2,Nj,Ni] planes
+            # never materialize)
+            return consume(carry, rgba, sk, sk + ds)
         t0 = sk[:, None, None] * length[None]
         t1 = (sk + ds)[:, None, None] * length[None]
         return consume(carry, rgba, t0, t1)
@@ -715,6 +732,10 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                            jnp.full((1, spec.nj, spec.ni), -1.0,
                                     jnp.float32), s0[None])
         empty = jnp.zeros((1, 4, spec.nj, spec.ni), jnp.float32)
+        if shaded_compact:
+            # all-empty chunk: slot -1 records never match a depth mask,
+            # so sk1 = sk0 + ds vs the plane path's t0 == t1 is moot
+            return consume(carry, empty, s0[None], s0[None] + ds)
         t = (s0 * length)[None]                            # [1, Nj, Ni]
         return consume(carry, empty, t, t)
 
@@ -966,12 +987,19 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         # packed-carry: the [K,...] state keeps one layout across the
         # whole scan so the kernel's input_output_aliases update it in
         # place (a NamedTuple carry would pay a stack/slice copy of the
-        # depth plane per chunk)
-        def consume(packed, rgba, t0, t1):
-            return psg.fold_chunk_packed(packed, rgba, t0, t1, threshold,
-                                         max_k=k)
+        # depth plane per chunk). Compact depth: the kernel computes
+        # t = sk*length itself — the [C,2,Nj,Ni] planes never hit HBM.
+        length = axcam.ray_lengths()
 
-        packed = march(consume, psg.init_seg_packed(k, nj, ni))
+        def consume(packed, rgba, sk0, sk1):
+            return psg.fold_chunk_packed(packed, rgba, threshold=threshold,
+                                         max_k=k, sk0=sk0, sk1=sk1,
+                                         length=length)
+
+        packed = slice_march(vol, tf, axcam, spec, consume,
+                             psg.init_seg_packed(k, nj, ni),
+                             u_bounds, v_bounds, occupancy=occ,
+                             shaded_compact=True)
         color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
     elif spec.fold in ("pallas_fused", "fused_stream"):
         # shade-in-kernel: the march feeds the raw resampled value plane
@@ -1121,13 +1149,17 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
             state = marcher(vol, tf, axcam, spec, thr, k, occ,
                             u_bounds, v_bounds)
         elif spec.fold == "pallas_seg":
-            def consume(packed, rgba, t0, t1):
-                return psg.fold_chunk_packed(packed, rgba, t0, t1, thr,
-                                             max_k=k)
+            length = axcam.ray_lengths()
+
+            def consume(packed, rgba, sk0, sk1):
+                return psg.fold_chunk_packed(packed, rgba, threshold=thr,
+                                             max_k=k, sk0=sk0, sk1=sk1,
+                                             length=length)
 
             packed = slice_march(vol, tf, axcam, spec, consume,
                                  psg.init_seg_packed(k, nj, ni),
-                                 u_bounds, v_bounds, occupancy=occ)
+                                 u_bounds, v_bounds, occupancy=occ,
+                                 shaded_compact=True)
             state = psg.unpack_seg_state(packed)
         else:
             def consume(st, rgba, t0, t1):

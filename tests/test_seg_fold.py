@@ -264,3 +264,38 @@ def test_k1_everything_merges():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_compact_depth_equals_td_planes():
+    """fold_chunk_packed's compact form (sk ratios + length, depths
+    computed in-kernel) must equal the td-plane form when the planes are
+    the same outer product the march materializes (t = sk * length) —
+    the production path's 3.4 GB/march stream delete must be a pure
+    traffic change, bit-for-bit."""
+    import numpy as np
+    from scenery_insitu_tpu.ops import pallas_seg as psg
+
+    rng = np.random.default_rng(11)
+    c, k, h, w = 6, 4, 8, 256
+    rgba = jnp.asarray(rng.random((c, 4, h, w), dtype=np.float32))
+    # sprinkle empties so segmentation paths (starts/gaps) are exercised
+    rgba = rgba.at[:, 3].set(
+        jnp.where(jnp.asarray(rng.random((c, h, w))) < 0.3, 0.0,
+                  rgba[:, 3]))
+    sk = jnp.asarray(np.sort(rng.random(c).astype(np.float32)) + 0.5)
+    ds = jnp.float32(0.03)
+    length = jnp.asarray(1.0 + rng.random((h, w), dtype=np.float32))
+    thr = jnp.full((h, w), 0.15, jnp.float32)
+
+    t0 = sk[:, None, None] * length[None]
+    t1 = (sk + ds)[:, None, None] * length[None]
+
+    pk0 = psg.init_seg_packed(k, h, w)
+    ref = psg.fold_chunk_packed(pk0, rgba, t0, t1, thr, max_k=k,
+                                interpret=True)
+    got = psg.fold_chunk_packed(pk0, rgba, threshold=thr, max_k=k,
+                                sk0=sk, sk1=sk + ds, length=length,
+                                interpret=True)
+    for a, b, name in zip(ref, got, ("color", "depth", "small")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6, err_msg=name)

@@ -16,6 +16,9 @@ for each schedule:
                K-state touched once per chunk
   pallas_seg   the seg fold's VMEM pixel-strip twin (ops/pallas_seg.py,
                fold="pallas_seg" — the round-4 TPU default)
+  pallas_seg_c pallas_seg with COMPACT depth (sk ratios + length,
+               t = sk*length computed in-kernel — the round-5 production
+               schedule; the [C,2,H,W] depth planes never exist in HBM)
   pallas       pm.fold_chunk per chunk (fold="pallas") — since the
                two-phase rewrite this IS the events schedule with a
                rolled phase 2
@@ -421,6 +424,24 @@ def build(variant: str, s_total: int, c: int, k: int, h: int, w: int):
                 rgba, t0, t1 = stream_chunk(ci, c, h, w)
                 return psg.fold_chunk_packed(packed, rgba, t0, t1, thr,
                                              max_k=k), None
+            packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
+                                     jnp.arange(nchunks))
+            return sfold.seg_finalize(psg.unpack_seg_state(packed))
+    elif variant == "pallas_seg_c":
+        # COMPACT depth form — the round-5 production schedule: the
+        # kernel computes t = sk*length in-kernel, so the [C,2,H,W]
+        # depth planes never exist (stream_chunk's t0 = s*0.01 with
+        # length ≡ 1 is exactly this outer product, so parity against
+        # the xla reference is exact)
+        length1 = jnp.ones((h, w), jnp.float32)
+
+        def run():
+            def body(packed, ci):
+                rgba, _, _ = stream_chunk(ci, c, h, w)
+                sk0 = (ci * c + jnp.arange(c, dtype=jnp.float32)) * 0.01
+                return psg.fold_chunk_packed(
+                    packed, rgba, threshold=thr, max_k=k, sk0=sk0,
+                    sk1=sk0 + 0.01, length=length1), None
             packed, _ = jax.lax.scan(body, psg.init_seg_packed(k, h, w),
                                      jnp.arange(nchunks))
             return sfold.seg_finalize(psg.unpack_seg_state(packed))

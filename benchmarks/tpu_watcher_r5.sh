@@ -157,7 +157,7 @@ run_step() {  # run_step <n>
     # fold microbench, core schedules (floors + seg family)
     19) run_jsonl "$R/fold_microbench_512_core_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \
-         --variants none,count,xla,seg,pallas_seg ;;
+         --variants none,count,xla,seg,pallas_seg,pallas_seg_c ;;
     # fold microbench, fused family (+ its controlled baselines)
     20) run_jsonl "$R/fold_microbench_512_fused_r5.jsonl" 1500 \
          python benchmarks/fold_microbench.py --grid 512 --iters 3 --check \

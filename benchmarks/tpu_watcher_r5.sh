@@ -78,8 +78,9 @@ run_step() {  # run_step <n>
     3) run_json "$R/bench_tpu_r5_512_render.json" 900 env \
          SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_SIM_STEPS=0 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;
-    # flagship RE-capture after the T-step sim-fusion lever (the
-    # step-1 artifact is the pre-fusion baseline; same config otherwise)
+    # flagship RE-capture after the round-5 traffic levers (2D T-step
+    # sim fusion + compact-depth fold; the step-1 artifact is the
+    # pre-lever baseline — steps 8-11 isolate the fold dimension)
     4) run_json "$R/bench_tpu_r5_512_simfused.json" 900 env \
          SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=700 python bench.py ;;

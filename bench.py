@@ -129,14 +129,19 @@ def _frame_bytes_accessed(jitted, *args):
 
 
 def _model_frame_bytes(grid: int, sim_steps: int, marches: int,
-                       render_bytes: int) -> float:
+                       render_bytes: int, sim_fused: bool) -> float:
     """Floor-model of one frame's HBM traffic when XLA cost analysis is
-    unavailable: sim reads+writes u,v per step (4 arrays x 4 B), the
-    render copy is written once and read once per march. Fold-state and
-    stream traffic are schedule-dependent and EXCLUDED — this is a lower
-    bound, so achieved-GB/s derived from it is also a lower bound."""
+    unavailable: the sim term comes from the fused-stencil schedule model
+    (sim/pallas_stencil.modeled_sim_traffic — r+w of u,v per step when
+    unfused), the render copy is written once and read once per march.
+    Fold-state and stream traffic are schedule-dependent and EXCLUDED —
+    this is a lower bound, so achieved-GB/s derived from it is also a
+    lower bound."""
+    from scenery_insitu_tpu.sim import pallas_stencil as ps
+
     vox = float(grid) ** 3
-    sim = sim_steps * 4 * vox * 4.0
+    sim = ps.modeled_sim_traffic((grid, grid, grid), sim_steps,
+                                 fused=sim_fused) if sim_steps else 0.0
     render_copy = vox * render_bytes
     return sim + render_copy + marches * vox * render_bytes
 
@@ -197,9 +202,15 @@ def main():
     # models/pipelines.py render_dtype). Explicit env overrides.
     render_dtype = os.environ.get("SITPU_BENCH_RENDER_DTYPE",
                                   "bf16" if grid >= 1024 else "f32")
+    # accept the long spellings; config validation only knows the short
+    render_dtype = {"bfloat16": "bf16", "float32": "f32"}.get(render_dtype,
+                                                              render_dtype)
     # in-plane occupancy tiles (0 = chunk skipping only; try 8 on sparse
     # fields — see SliceMarchConfig.occupancy_vtiles)
     vtiles = _env_int("SITPU_BENCH_VTILES", 0)
+    # sim-fusion lever A/B: 0 pins the XLA roll formulation (the un-fused
+    # baseline the time-fused Pallas stencil is measured against)
+    sim_fused = bool(_env_int("SITPU_BENCH_SIM_FUSED", 1))
 
     from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
@@ -223,7 +234,7 @@ def main():
                                      adaptive_iters=ad_iters),
             engine=engine, grid_shape=(grid, grid, grid),
             axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
-            slicer_cfg=mc, render_dtype=render_dtype)
+            slicer_cfg=mc, render_dtype=render_dtype, sim_fused=sim_fused)
 
     # the mxu step is compiled for the base camera's march regime (axis z
     # here); oscillate the orbit within ±0.35 rad so every benched frame
@@ -387,7 +398,8 @@ def main():
         # for the slice march; the gather engine's traffic is sample-
         # driven and can undercut it, so no model fallback there
         rb = 2 if render_dtype in ("bf16", "bfloat16") else 4
-        hbm_bytes = _model_frame_bytes(grid, sim_steps, marches, rb)
+        hbm_bytes = _model_frame_bytes(grid, sim_steps, marches, rb,
+                                       sim_fused)
         hbm_src = "min_traffic_model"
     hbm_gbps = hbm_bytes / dt / 1e9 if hbm_bytes else None
     peak_bw = _peak_hbm(dev.device_kind, platform)
@@ -427,6 +439,7 @@ def main():
         "hbm_bytes_source": hbm_src,
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
+                   "sim_fused": sim_fused,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
                    "chunk": chunk, "scan_frames": bool(scan_frames),
                    "autotune_ms": autotune_ms,

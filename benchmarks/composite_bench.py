@@ -102,6 +102,8 @@ def main():
 
     import jax
 
+    from scenery_insitu_tpu.utils.compat import shard_map
+
     if os.environ.get(_CHILD) == "1":
         pin_cpu_backend()
     elif os.environ.get("SITPU_BENCH_REAL") == "1":
@@ -164,7 +166,7 @@ def main():
             out = composite_vdis(colors, depths, comp_cfg)
             return out.color, out.depth
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             step, mesh=mesh, in_specs=(P(axis), P(axis)),
             out_specs=(P(None, None, None, axis), P(None, None, None, axis)),
             check_vma=False))

@@ -35,6 +35,13 @@ def main():
     ap.add_argument("--k", type=int, default=8)
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--sim-steps", type=int, default=5)
+    # HBM-traffic lever A/Bs (ISSUE 1): bf16 marched-volume copy,
+    # time-fused sim stencil, and the scanned frame loop (N frames in
+    # one executable; 0 = skip that measurement)
+    ap.add_argument("--render-dtype", choices=("f32", "bf16"),
+                    default="f32")
+    ap.add_argument("--sim-fused", type=int, default=0)
+    ap.add_argument("--scan-frames", type=int, default=0)
     args = ap.parse_args()
     n = args.ranks
 
@@ -46,6 +53,8 @@ def main():
         reexec_virtual_mesh(n, _CHILD)
 
     import jax
+
+    from scenery_insitu_tpu.utils.compat import shard_map
 
     if os.environ.get(_CHILD) == "1":
         pin_cpu_backend()
@@ -75,14 +84,26 @@ def main():
     comp_cfg = CompositeConfig(max_output_supersegments=args.k,
                                adaptive_iters=2)
     mcfg = SliceMarchConfig(
-        matmul_dtype="f32" if jax.default_backend() != "tpu" else "bf16")
+        matmul_dtype="f32" if jax.default_backend() != "tpu" else "bf16",
+        render_dtype=args.render_dtype)
     spec = slicer.make_spec(cam, (g, g, g), mcfg, multiple_of=n)
 
     origin = jnp.array([-1.0, -1.0, -1.0], jnp.float32)
     spacing = jnp.full((3,), 2.0 / g, jnp.float32)
 
     # --------------------------------------------------- split-stage fns
-    sim_fn = jax.jit(lambda u, v: gs.multi_step(
+    sim_fused = bool(args.sim_fused)
+    if sim_fused and n > 1:
+        # the fused Pallas stencil's periodic wrap is per-buffer, so it
+        # cannot run on z-sharded state (sim/pallas_stencil.py) — the
+        # multi-rank sim lever is the roll path, same as the session's
+        # scan guard
+        print("[phase_bench] --sim-fused needs a 1-rank mesh (the Pallas "
+              "stencil is not partitionable); using the roll path",
+              file=sys.stderr)
+        sim_fused = False
+    advance = gs.multi_step_fast if sim_fused else gs.multi_step
+    sim_fn = jax.jit(lambda u, v: advance(
         gs.GrayScott(u, v, gs.GrayScottParams.create()), args.sim_steps))
 
     def gen(local, o, s, c):
@@ -90,7 +111,7 @@ def main():
                                              tf, vdi_cfg, axis, n)
         return vdi.color, vdi.depth
 
-    gen_fn = jax.jit(jax.shard_map(
+    gen_fn = jax.jit(shard_map(
         gen, mesh=mesh, in_specs=(P(axis, None, None), P(), P(), P()),
         out_specs=(P(axis), P(axis)), check_vma=False))
 
@@ -98,7 +119,7 @@ def main():
         return (_exchange_columns(color, n, axis),
                 _exchange_columns(depth, n, axis))
 
-    exch_fn = jax.jit(jax.shard_map(
+    exch_fn = jax.jit(shard_map(
         exch, mesh=mesh, in_specs=(P(axis), P(axis)),
         out_specs=(P(axis), P(axis)), check_vma=False))
 
@@ -106,7 +127,7 @@ def main():
         out = composite_vdis(colors, depths, comp_cfg)
         return out.color, out.depth
 
-    comp_fn = jax.jit(jax.shard_map(
+    comp_fn = jax.jit(shard_map(
         comp, mesh=mesh, in_specs=(P(axis), P(axis)),
         out_specs=(P(None, None, None, axis), P(None, None, None, axis)),
         check_vma=False))
@@ -151,6 +172,36 @@ def main():
         vdi_f, _ = tick("fused_total", fused, v, origin, spacing, cam)
 
     ms = {k: round(t / args.iters * 1000, 2) for k, t in phases.items()}
+
+    # scanned frame loop: sim+render frames rolled into ONE executable
+    # (the session's scan_frames path) — per-frame ms against the eager
+    # fused_total isolates the per-launch dispatch tax
+    scan_ms = None
+    if args.scan_frames > 1:
+        from scenery_insitu_tpu.parallel.pipeline import frame_scan
+
+        params = gs.GrayScottParams.create()
+        # the same advance the eager phases measured (sim_fused already
+        # downgraded to the roll path on multi-rank meshes above), so
+        # scanloop isolates the launch lever and nothing else
+        runner = frame_scan(
+            fused, lambda s: advance(s, args.sim_steps),
+            args.scan_frames)
+        state = gs.GrayScott(u, v, params)
+        # warm TWICE: the chained state's sharding/layout can differ
+        # between the fresh inputs and the runner's own outputs, and the
+        # second compilation must not land in the timed window
+        for _ in range(2):
+            (state, _, _), outs = runner(state, origin, spacing, cam,
+                                         jnp.float32(0.0))
+        jax.block_until_ready(outs[0].color)               # warm
+        t0 = time.perf_counter()
+        (state, _, _), outs = runner(state, origin, spacing, cam,
+                                     jnp.float32(0.0))
+        jax.block_until_ready(outs[0].color)
+        scan_ms = round((time.perf_counter() - t0)
+                        / args.scan_frames * 1000, 2)
+
     # the fused step covers generate+all_to_all+composite ONLY (sim runs
     # before it, gather after) — compare like with like
     split_render = sum(ms[k] for k in ("generate", "all_to_all", "composite"))
@@ -161,6 +212,10 @@ def main():
         "split_render_ms": round(split_render, 2),
         "fused_render_ms": ms["fused_total"],
         "overlap_gain": round(split_render / max(ms["fused_total"], 1e-9), 2),
+        "levers": {"render_dtype": args.render_dtype,
+                   "sim_fused": sim_fused,    # EFFECTIVE (multi-rank
+                   "scan_frames": args.scan_frames,  # downgrades to roll)
+                   "scanloop_ms_per_frame": scan_ms},
         "backend": jax.default_backend(),
     }))
 

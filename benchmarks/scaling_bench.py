@@ -66,6 +66,8 @@ def main():
 
     import jax
 
+    from scenery_insitu_tpu.utils.compat import shard_map
+
     if os.environ.get(_CHILD) == "1" or tpu_probe_failed:
         pin_cpu_backend()
     enable_compile_cache()
@@ -155,7 +157,7 @@ def main():
 
                 return rt(c), rt(d)
 
-            exch = jax.jit(jax.shard_map(
+            exch = jax.jit(shard_map(
                 exch_roundtrip, mesh=mesh, in_specs=(P(axis), P(axis)),
                 out_specs=(P(axis), P(axis)), check_vma=False))
             sh = NamedSharding(mesh, P(axis))

@@ -97,6 +97,14 @@ class SliceMarchConfig:
     chunk: int = 16
     # Resampling matmul operand dtype: "bf16" (MXU-native) or "f32".
     matmul_dtype: str = "bf16"
+    # Storage dtype of the MARCHED volume copy: "bf16" halves the volume
+    # bytes every march (and the distributed halo-exchange bytes) — the
+    # resampling matmuls were casting operands to bf16 anyway
+    # (matmul_dtype) and all accumulation stays f32, so the render-side
+    # precision loss is one storage rounding of the field. The SIM state
+    # is never touched (its ~1e-3 per-step increments need f32 — see
+    # models/pipelines.py). "f32" = render the sim field as-is.
+    render_dtype: str = "f32"
     # Minimum eye-depth ratio; slices closer to the eye plane are dropped.
     s_floor: float = 1e-3
     # Empty-space skipping: skip slice chunks whose value range maps to
@@ -129,6 +137,14 @@ class SliceMarchConfig:
     #   "auto"       pallas_seg on TPU (compile-probe gated, falling back
     #                to seg), xla elsewhere.
     fold: str = "auto"
+
+    def __post_init__(self):
+        if self.matmul_dtype not in ("bf16", "f32"):
+            raise ValueError(f"matmul_dtype must be 'bf16' or 'f32', "
+                             f"got {self.matmul_dtype!r}")
+        if self.render_dtype not in ("bf16", "f32"):
+            raise ValueError(f"render_dtype must be 'bf16' or 'f32', "
+                             f"got {self.render_dtype!r}")
 
 
 @dataclass(frozen=True)
@@ -180,6 +196,12 @@ class SimConfig:
     # Sphere radius for the particle/hybrid render paths: world units for
     # lennard_jones/sho, voxel units for hybrid tracers.
     particle_radius: float = 0.35
+    # Advance gray_scott through the time-fused Pallas stencil on TPU
+    # (sim/pallas_stencil.py — T steps per volume round trip instead of
+    # one; probe-gated, degrades to the XLA roll path off-TPU or when no
+    # schedule compiles). False pins the XLA roll formulation — the
+    # sim-fusion lever's A/B switch.
+    fused_stencil: bool = True
 
 
 @dataclass(frozen=True)
@@ -194,6 +216,13 @@ class RuntimeConfig:
     benchmark_frames: int = 100
     stats_window: int = 100         # frames between timer-stat dumps
     dataset: str = "procedural"
+    # Frames rolled into ONE lax.scan-based executable per launch (0/1 =
+    # eager per-frame dispatch). Amortizes the per-launch dispatch tax
+    # (docs/PERF.md H2) at the cost of steering/camera latency: steering
+    # drains and regime changes only take effect at block boundaries.
+    # Applies to volume-sim VDI sessions; other modes fall back to the
+    # eager loop (runtime/session.py logs the downgrade).
+    scan_frames: int = 0
 
 
 @dataclass(frozen=True)

@@ -82,6 +82,37 @@ def _lz4_dec(b):
 CODECS["lz4"] = (_lz4_enc, _lz4_dec)
 
 
+_ZSTD_OK = None
+
+
+def have_zstd() -> bool:
+    """Is the optional zstandard package importable? Cached."""
+    global _ZSTD_OK
+    if _ZSTD_OK is None:
+        try:
+            import zstandard  # noqa: F401
+            _ZSTD_OK = True
+        except ImportError:
+            _ZSTD_OK = False
+    return _ZSTD_OK
+
+
+def resolve_codec(codec: str) -> str:
+    """Degrade the default "zstd" to stdlib "zlib" (one warning) when the
+    optional zstandard package is missing — an in-situ dump/stream must
+    not die because of an absent compression extra. Applied at the
+    WRITER entry points (save_vdi, pack_vdi_segments, VDIPublisher), and
+    at unpack for symmetry; raw compress()/decompress() stay strict —
+    data already written as zstd genuinely needs the module."""
+    if codec == "zstd" and not have_zstd():
+        import warnings
+        warnings.warn("zstandard is not installed; writing zlib instead "
+                      "(install zstandard for the default codec)",
+                      stacklevel=3)
+        return "zlib"
+    return codec
+
+
 def compress(data: bytes, codec: str = "zstd", level: int = -1) -> bytes:
     """level = -1 picks each codec's default."""
     try:
@@ -108,6 +139,7 @@ def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
     The npz members are individually compressed with ``codec`` (numpy's own
     deflate is off) so load/save round-trips are bit-exact and fast.
     """
+    codec = resolve_codec(codec)
     members = {"color": np.asarray(vdi.color), "depth": np.asarray(vdi.depth),
                "__codec__": np.frombuffer(codec.encode(), np.uint8)}
     if meta is not None:
@@ -160,6 +192,7 @@ def pack_vdi_segments(vdi: VDI, n: int, codec: str = "zstd",
     i64[n], depth_limits i64[n]) — the variable-length collective wire
     format (≅ colorLimits/depthLimits IntArrays,
     VDICompositingTest.kt:87-91,251-304)."""
+    codec = resolve_codec(codec)
     k, _, h, w = vdi.color.shape
     if w % n:
         raise ValueError(f"width {w} not divisible into {n} segments")
@@ -181,6 +214,19 @@ def unpack_vdi_segments(blobs: Sequence[bytes], k: int, h: int, w: int,
     """Inverse of pack_vdi_segments (≅ the decompress-on-receive path,
     handleReceivedBuffersAndUploadForCompositing,
     VDICompositingTest.kt:360-415)."""
+    if codec == "zstd" and blobs:
+        # sniff the first blob's frame magic so the degrade is SYMMETRIC
+        # with pack's: blobs from a zstandard-less writer (zlib) decode
+        # on any reader, and genuinely-zstd blobs on a zstandard-less
+        # reader get the clear missing-module error instead of a zlib
+        # header failure
+        if bytes(blobs[0][:4]) == b"\x28\xb5\x2f\xfd":
+            if not have_zstd():
+                raise ImportError(
+                    "these segments were compressed with zstd but the "
+                    "zstandard package is not installed")
+        else:
+            codec = "zlib"
     n = len(blobs) // 2
     seg_w = w // n
     cs = [np.frombuffer(decompress(b, codec), np.float32)

@@ -30,10 +30,18 @@ def grayscott_vdi_frame_step(width: int, height: int,
                              fov_y_deg: float = 50.0,
                              engine: str = "auto",
                              grid_shape=None, axis_sign=None,
-                             slicer_cfg=None, render_dtype: str = "f32"):
+                             slicer_cfg=None,
+                             render_dtype: Optional[str] = None,
+                             sim_fused: bool = True):
     """Single-chip in-situ frame step: Gray-Scott advance → VDI generation
     → composite. Returns ``fn(u, v, eye) -> (color, depth, u, v)``
     (jittable; the flagship single-device hot path).
+
+    ``render_dtype`` (None = ``slicer_cfg.render_dtype``): "bf16" marches
+    a bf16 copy of the density volume (storage only — accumulation stays
+    f32; see SliceMarchConfig.render_dtype). ``sim_fused=False`` pins the
+    sim advance to the XLA roll formulation instead of the time-fused
+    Pallas stencil — the sim-fusion lever's A/B switch.
 
     engine="mxu" uses the slice-march raycaster (ops/slicer.py; requires
     the static ``grid_shape`` AND ``axis_sign`` — the march regime, from
@@ -50,6 +58,9 @@ def grayscott_vdi_frame_step(width: int, height: int,
     jittable histogram counting march), then thread it through the frame
     loop (one march per frame instead of two; see
     slicer.generate_vdi_mxu_temporal)."""
+    import dataclasses
+
+    from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
 
     tf = tf or for_dataset("gray_scott")
@@ -58,6 +69,14 @@ def grayscott_vdi_frame_step(width: int, height: int,
                                            adaptive_iters=2)
     params = params or gs.GrayScottParams.create()
     engine = slicer.resolve_engine(engine)
+    slicer_cfg = slicer_cfg or SliceMarchConfig()
+    if render_dtype is None:
+        render_dtype = slicer_cfg.render_dtype
+    else:
+        # keep the spec in lockstep with the explicit override so
+        # permute_volume and the pre-cast field copy below agree
+        slicer_cfg = dataclasses.replace(slicer_cfg,
+                                         render_dtype=render_dtype)
 
     spec = None
     if engine == "mxu":
@@ -84,6 +103,7 @@ def grayscott_vdi_frame_step(width: int, height: int,
     # the march's permuted volume halves to ~2.1 GB at 1024^3 and the
     # resampling einsum was casting to bf16 anyway (matmul_dtype)
     rdt = jnp.bfloat16 if render_dtype == "bf16" else None
+    advance = gs.multi_step_fast if sim_fused else gs.multi_step
 
     def frame_step(u, v, eye, thr=None):
         if temporal and thr is None:
@@ -91,7 +111,7 @@ def grayscott_vdi_frame_step(width: int, height: int,
                 "temporal mode carries threshold state: call as "
                 "frame_step(u, v, eye, thr), seeding thr with "
                 "frame_step.init_threshold(u, v, eye)")
-        state = gs.multi_step_fast(gs.GrayScott(u, v, params), sim_steps)
+        state = advance(gs.GrayScott(u, v, params), sim_steps)
         field = state.field if rdt is None else state.field.astype(rdt)
         vol = Volume.centered(field, extent=2.0)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)

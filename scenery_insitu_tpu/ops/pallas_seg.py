@@ -43,6 +43,7 @@ from scenery_insitu_tpu.ops import seg_fold as sf
 from scenery_insitu_tpu.ops import supersegments as ss
 from scenery_insitu_tpu.ops.pallas_march import _pick_block_w
 from scenery_insitu_tpu.ops.pallas_util import TILE_H, should_interpret
+from scenery_insitu_tpu.utils.compat import tpu_compiler_params
 
 _CNT, _PREV_RGB, _PREV_EMPTY = 0, slice(1, 4), 4
 _NSMALL = 5
@@ -195,13 +196,19 @@ def fold_chunk_packed(packed, rgba: jnp.ndarray, t0=None, t1=None,
     """
     if interpret is None:
         interpret = should_interpret()
-    compact = sk0 is not None
+    planes_any = t0 is not None or t1 is not None
+    compact_any = (sk0 is not None or sk1 is not None
+                   or length is not None)
     planes_full = t0 is not None and t1 is not None
     compact_full = (sk0 is not None and sk1 is not None
                     and length is not None)
-    if planes_full == compact_full or not (planes_full or compact_full):
+    if planes_any and compact_any:
+        raise ValueError("depth forms cannot be mixed: got t0/t1 plane "
+                         "args together with sk0/sk1/length compact args")
+    if not (planes_full or compact_full):
         raise ValueError("pass exactly one COMPLETE depth form: "
                          "(t0, t1) or (sk0, sk1, length)")
+    compact = compact_full
     color, depth, small = packed
     kk = color.shape[0]
     _, _, h, w = color.shape
@@ -513,7 +520,7 @@ def fused_stream_fold(packed, val: jnp.ndarray, length: jnp.ndarray,
         out_shape=[jax.ShapeDtypeStruct(p.shape, p.dtype) for p in packed],
         scratch_shapes=[pltpu.VMEM((c, 7, TILE_H, wb), jnp.float32)],
         input_output_aliases={6: 0, 7: 1, 8: 2},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(val, length, ratio, threshold, sk0, sk1, *packed)
@@ -539,10 +546,14 @@ def seg_compile_ok(max_k: int = 32, chunk: int = 16,
 
             # probe BOTH kernel variants the production march can trace:
             # the compact-depth form (what the march feeds) and the
-            # td-plane form (tests / arbitrary streams)
-            def f(pk, rgba, sk, ln, thr):
+            # td-plane form (tests / arbitrary streams). sk0 and sk1 are
+            # DISTINCT inputs: binding both to one traced array would let
+            # the compiler CSE the t0a/t1a temporaries into one
+            # [C,TH,WB] buffer and accept a smaller kernel than the
+            # production one, which always carries two sk streams.
+            def f(pk, rgba, sk0, sk1, ln, thr):
                 return fold_chunk_packed(pk, rgba, threshold=thr,
-                                         max_k=k, sk0=sk, sk1=sk,
+                                         max_k=k, sk0=sk0, sk1=sk1,
                                          length=ln)
 
             def g(st, rgba, t0, t1, thr):
@@ -553,7 +564,8 @@ def seg_compile_ok(max_k: int = 32, chunk: int = 16,
                   sds((_NSMALL, h, w), jnp.float32))
             jax.jit(f).lower(
                 pk, sds((c, 4, h, w), jnp.float32),
-                sds((c,), jnp.float32), sds((h, w), jnp.float32),
+                sds((c,), jnp.float32), sds((c,), jnp.float32),
+                sds((h, w), jnp.float32),
                 sds((h, w), jnp.float32)).compile()
             st = sf.SegFoldState(
                 out_color=sds((k, 4, h, w), jnp.float32),

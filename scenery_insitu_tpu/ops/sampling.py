@@ -33,7 +33,9 @@ def sample_trilinear(data: jnp.ndarray, pos_xyz: jnp.ndarray) -> jnp.ndarray:
     z1 = jnp.minimum(z0 + 1, d - 1)
 
     def at(zi, yi, xi):
-        return jnp.take(flat, (zi * h + yi) * w + xi)
+        # gather in storage dtype (bf16 render copies keep their halved
+        # HBM traffic), accumulate the lerp in f32
+        return jnp.take(flat, (zi * h + yi) * w + xi).astype(jnp.float32)
 
     c000 = at(z0, y0, x0)
     c001 = at(z0, y0, x1)

@@ -89,6 +89,12 @@ class AxisSpec:
     # "pallas" (round-3 two-phase machine kernel) | "seg" (round-4
     # segmented-scan fold, ops/seg_fold.py) | "pallas_seg" (its VMEM twin)
     fold: str = "xla"
+    # storage dtype of the marched volume copy: "bf16" makes
+    # `permute_volume` emit a bf16 march layout — volume bytes halve for
+    # every march (and for the distributed halo exchange) while all
+    # accumulation stays f32 (the resampling einsum sets
+    # preferred_element_type=f32 and the folds run f32 throughout)
+    render_dtype: str = "f32"
     # in-plane occupancy granularity: 0 = whole-chunk skipping only;
     # N > 0 additionally splits each slice plane into N row (v) tiles and
     # skips the resampling matmuls + TF for OUTPUT row blocks whose
@@ -197,7 +203,7 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
     return AxisSpec(axis=axis, sign=sign, ni=ni, nj=nj,
                     chunk=cfg.chunk, matmul_dtype=dtype,
                     s_floor=cfg.s_floor, skip_empty=cfg.skip_empty,
-                    fold=fold, vtiles=vt)
+                    fold=fold, vtiles=vt, render_dtype=cfg.render_dtype)
 
 
 class AxisCamera(NamedTuple):
@@ -238,11 +244,19 @@ def permute_volume(vol: Volume, spec: AxisSpec) -> jnp.ndarray:
     """Volume data -> march layout ``[S, (ch,) Nv, Nu]`` (slice, optional
     channels, in-plane v, u), flipped so marched slice index ascends
     front-to-back. A leading channel dim of pre-shaded RGBA volumes moves
-    BEHIND the slice dim so the march can slab-slice on dim 0."""
-    nd = vol.data.ndim
+    BEHIND the slice dim so the march can slab-slice on dim 0.
+
+    ``spec.render_dtype == "bf16"`` emits the march layout in bf16 — the
+    copy every march reads halves in HBM (XLA CSEs the one cast+transpose
+    across the occupancy pass and the marches of a frame); accumulation
+    downstream stays f32."""
+    data = vol.data
+    if spec.render_dtype == "bf16" and data.dtype == jnp.float32:
+        data = data.astype(jnp.bfloat16)
+    nd = data.ndim
     perm3 = {2: (0, 1, 2), 1: (1, 0, 2), 0: (2, 0, 1)}[spec.axis]
     dims = [nd - 3 + p for p in perm3]
-    volp = jnp.transpose(vol.data,
+    volp = jnp.transpose(data,
                          [dims[0]] + list(range(nd - 3)) + dims[1:])
     if spec.sign < 0:
         volp = jnp.flip(volp, axis=0)
@@ -376,8 +390,9 @@ def chunk_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
         alpha = volp[:, 3]
         return alpha.reshape(nchunks, -1).max(axis=1) > alpha_eps
     slabs = volp.reshape(nchunks, -1)
-    lo = jnp.clip(jnp.min(slabs, axis=1), 0.0, 1.0)
-    hi = jnp.clip(jnp.max(slabs, axis=1), 0.0, 1.0)
+    # reduce in storage dtype, evaluate the TF in f32 (bf16 march copies)
+    lo = jnp.clip(jnp.min(slabs, axis=1).astype(jnp.float32), 0.0, 1.0)
+    hi = jnp.clip(jnp.max(slabs, axis=1).astype(jnp.float32), 0.0, 1.0)
     return tf.max_alpha_in(lo, hi) > alpha_eps
 
 
@@ -434,8 +449,10 @@ def chunk_occupancy_vtiles(vol: Volume, tf: TransferFunction,
         if pre_shaded:
             occ.append(band.max(axis=1) > alpha_eps)
         else:
-            lo = jnp.clip(jnp.min(band, axis=1), 0.0, 1.0)
-            hi = jnp.clip(jnp.max(band, axis=1), 0.0, 1.0)
+            lo = jnp.clip(jnp.min(band, axis=1).astype(jnp.float32),
+                          0.0, 1.0)
+            hi = jnp.clip(jnp.max(band, axis=1).astype(jnp.float32),
+                          0.0, 1.0)
             occ.append(tf.max_alpha_in(lo, hi) > alpha_eps)
             los.append(lo)
             his.append(hi)

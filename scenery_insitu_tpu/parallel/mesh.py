@@ -57,7 +57,8 @@ def halo_exchange_z(local: jnp.ndarray, axis_name: str = DEFAULT_AXIS,
     not exceed the slab depth — deeper halos would need multi-hop
     exchanges; use fewer ranks or a smaller radius instead.
     """
-    n = jax.lax.axis_size(axis_name)
+    from scenery_insitu_tpu.utils.compat import axis_size
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     dn = local.shape[0]
     if h > dn:

@@ -41,8 +41,7 @@ from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
 from scenery_insitu_tpu.parallel.mesh import halo_exchange_z
 
-# requires jax >= 0.8 (jax.shard_map with check_vma)
-shard_map = jax.shard_map
+from scenery_insitu_tpu.utils.compat import shard_map
 
 
 def _local_volume_and_clip(local_data: jnp.ndarray, origin: jnp.ndarray,
@@ -121,7 +120,14 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
     slab BEFORE trimming to the march extent — a radius-``shade_halo``
     neighborhood operator inside ``shade`` then sees real neighbor
     slices, making its output seam-exact vs a single-device run. The
-    shader may change the channel layout (scalar → pre-shaded RGBA)."""
+    shader may change the channel layout (scalar → pre-shaded RGBA).
+
+    ``spec.render_dtype == "bf16"`` casts the marched slab to bf16 UP
+    FRONT — the halo-exchange ICI bytes and every march's volume reads
+    halve; shaded (AO) slabs shade in f32 first and cast the result."""
+    if getattr(spec, "render_dtype", "f32") == "bf16" and shade is None \
+            and local_data.dtype == jnp.float32:
+        local_data = local_data.astype(jnp.bfloat16)
     r = jax.lax.axis_index(axis)
     dn = local_data.shape[0]
     h, w = local_data.shape[1], local_data.shape[2]
@@ -133,6 +139,9 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
         ext = halo_exchange_z(local_data, axis, h=hr)
         ext_origin = origin.at[2].add((r * dn - hr) * dz)
         local_data = shade(Volume(ext, ext_origin, spacing)).data
+        if getattr(spec, "render_dtype", "f32") == "bf16" \
+                and local_data.dtype == jnp.float32:
+            local_data = local_data.astype(jnp.bfloat16)
         # trim back: [hr:hr+dn] is the bare slab; the branches below
         # re-add their own 1-slice interpolation halo from the REAL
         # (already-shaded) neighbors kept around it
@@ -537,3 +546,46 @@ def shard_volume(data: jnp.ndarray, mesh: Mesh,
     """Place a global volume onto the mesh z-sharded (host → HBM shards)."""
     axis = axis_name or mesh.axis_names[0]
     return jax.device_put(data, NamedSharding(mesh, P(axis, None, None)))
+
+
+def frame_scan(step, advance, frames: int, temporal: bool = False,
+               field=lambda s: s.field):
+    """Roll ``frames`` (sim advance → render step → camera orbit)
+    iterations into ONE ``lax.scan``-based jitted executable — a single
+    launch per block instead of one executable launch per frame,
+    amortizing the per-launch dispatch tax (docs/PERF.md hypothesis H2;
+    bench.py's SCAN_FRAMES A/B measures the same lever single-chip).
+
+    ``step``: a built frame step — any of this module's distributed
+    steps or a single-chip equivalent — with signature
+    ``f(field, origin, spacing, cam) -> out`` (``temporal=True``:
+    ``f(field, origin, spacing, cam, thr) -> (out, thr')``).
+    ``advance``: traceable one-frame sim advance, ``state -> state``.
+    ``field``: extracts the rendered f32[D, H, W] field from the sim
+    state (default: the ``.field`` property every built-in volume sim
+    exposes).
+
+    Returns jitted ``run(state, origin, spacing, cam, orbit_rate
+    [, thr]) -> ((state', cam', thr'), outs)`` where ``outs`` stacks the
+    per-frame step outputs on a leading frame axis. The camera orbits by
+    ``orbit_rate`` radians AFTER each frame (pass 0.0 for a static
+    camera — ``orbit(cam, 0.0)`` is exact), so frame i renders with the
+    same camera the eager session loop would use. Steering (and, on the
+    MXU engine, march-regime changes) can only take effect at block
+    boundaries — the caller owns that check.
+    """
+    from scenery_insitu_tpu.core.camera import orbit as _orbit
+
+    def run(state, origin, spacing, cam, orbit_rate, thr=None):
+        def body(carry, _):
+            st, cam, thr = carry
+            st = advance(st)
+            if temporal:
+                out, thr2 = step(field(st), origin, spacing, cam, thr)
+            else:
+                out, thr2 = step(field(st), origin, spacing, cam), thr
+            return (st, _orbit(cam, orbit_rate), thr2), out
+
+        return jax.lax.scan(body, (state, cam, thr), None, length=frames)
+
+    return jax.jit(run)

@@ -132,7 +132,12 @@ class VolumeSimAdapter:
         self.kind = kind
         if kind == "gray_scott":
             self.state = gs.GrayScott.from_config(cfg.sim, seed=seed)
-            self._advance = lambda s, n: gs.multi_step(s, n)
+            # fused_stencil routes through the time-fused Pallas kernel
+            # on TPU (T steps per HBM round trip of u, v); off-TPU or
+            # with the flag off it is exactly the XLA roll path
+            adv = (gs.multi_step_fast if cfg.sim.fused_stencil
+                   else gs.multi_step)
+            self._advance = lambda s, n: adv(s, n)
         elif kind == "vortex":
             self.state = vx.VortexFlow.init_ring(tuple(cfg.sim.grid),
                                                  vx.VortexParams.create(dt=cfg.sim.dt))
@@ -287,6 +292,7 @@ class InSituSession:
         r = self.cfg.render
         self._mxu_steps = {}   # regime key -> jitted distributed step
         self._mxu_thr = {}     # regime key -> temporal threshold state
+        self._scan_steps = {}  # (kind, regime, block) -> scan executable
         self.mode = "vdi"
         if isinstance(self.sim, ParticleSimAdapter):
             # sort-first sphere rendering (≅ InVisRenderer + Head)
@@ -398,8 +404,20 @@ class InSituSession:
         host-side timers cannot see because the frame is one fused program
         (the reference logged host-side phase spans instead,
         DistributedVolumeRenderer.kt:622-648; see also
-        benchmarks/phase_bench.py for the split-stage numbers)."""
+        benchmarks/phase_bench.py for the split-stage numbers).
+
+        ``cfg.runtime.scan_frames > 1`` rolls blocks of frames into one
+        lax.scan executable per launch (parallel/pipeline.frame_scan) —
+        same frames, one dispatch — for supported modes; unsupported
+        modes log the downgrade and run the eager loop."""
         import contextlib
+
+        if self.cfg.runtime.scan_frames > 1:
+            ok, reason = self._scan_supported()
+            if ok:
+                return self._run_scan(frames, fetch, profile_dir)
+            self.log(f"scan_frames={self.cfg.runtime.scan_frames}: "
+                     f"falling back to the eager loop ({reason})")
 
         ctx = (jax.profiler.trace(profile_dir) if profile_dir
                else contextlib.nullcontext())
@@ -437,6 +455,168 @@ class InSituSession:
 
     def _enter_regime(self, key) -> None:
         drop_on_regime_reentry(self, self._mxu_thr, key)
+
+    # ------------------------------------------------- frame-scan blocks
+
+    def _scan_supported(self):
+        """Can this session roll frames into lax.scan blocks? Volume-sim
+        VDI sessions only: particles/hybrid/plain carry host-side render
+        state per frame, and a custom sim adapter gives no traceable
+        (state, advance) pair."""
+        if self.mode != "vdi":
+            return False, f"mode {self.mode!r} (volume VDI sessions only)"
+        if not isinstance(self.sim, VolumeSimAdapter):
+            return (False, "custom sim adapter (need the built-in "
+                           "traceable state/advance pair)")
+        return True, ""
+
+    def _scan_runner(self, block: int, regime):
+        """Build (or fetch) the scanned-block executable for a march
+        regime (None = the gather engine's regime-free step) and block
+        size; returns (runner, seed) where seed is the temporal
+        threshold seeder or None."""
+        from scenery_insitu_tpu.parallel.pipeline import (
+            distributed_initial_threshold_mxu, distributed_vdi_step_mxu,
+            distributed_vdi_step_mxu_temporal, frame_scan)
+
+        key = ("scan", regime, block)
+        entry = self._scan_steps.get(key)
+        if entry is None:
+            if regime is None:
+                step, seed = self._step, None
+            else:
+                n = self.mesh.shape[self.cfg.mesh.axis_name]
+                spec = self._slicer.make_spec(
+                    self.camera, self.sim.field.shape, self.cfg.slicer,
+                    axis_sign=regime, multiple_of=n)
+                if self._temporal:
+                    step = distributed_vdi_step_mxu_temporal(
+                        self.mesh, self.tf, spec, self.cfg.vdi,
+                        self.cfg.composite)
+                    seed = distributed_initial_threshold_mxu(
+                        self.mesh, self.tf, spec, self.cfg.vdi)
+                else:
+                    step = distributed_vdi_step_mxu(
+                        self.mesh, self.tf, spec, self.cfg.vdi,
+                        self.cfg.composite)
+                    seed = None
+            steps_per_frame = self.cfg.sim.steps_per_frame
+            mesh_n = self.mesh.shape[self.cfg.mesh.axis_name]
+            if mesh_n > 1 and self.sim.kind == "gray_scott":
+                # inside the scanned executable GSPMD propagates the
+                # render step's z-sharding back into the sim advance, and
+                # the fused Pallas stencil's periodic wrap is per-buffer
+                # (sim/pallas_stencil.py docstring) — pin the roll
+                # formulation, whose rolls XLA lowers to ICI halo
+                # exchanges, whenever the mesh can actually shard
+                advance = lambda s: gs.multi_step(s, steps_per_frame)
+            else:
+                advance = lambda s: self.sim._advance(s, steps_per_frame)
+            entry = (frame_scan(step, advance, block,
+                                temporal=self._temporal), seed)
+            self._scan_steps[key] = entry
+        return entry
+
+    def _run_scan(self, frames: int, fetch: bool,
+                  profile_dir: Optional[str]) -> dict:
+        """The scan-block twin of the eager loop: identical frames (same
+        sim advance, same per-frame camera ladder, same metadata), one
+        executable launch per block. Steering drains and regime changes
+        take effect at block boundaries only; a block whose host-replayed
+        camera path crosses march regimes runs eagerly instead (a scan
+        body cannot re-specialize mid-block). In temporal mode a missing
+        threshold state is seeded from the PRE-block field (the eager
+        loop seeds post-advance — one frame of controller lag, adapted
+        away like any temporal-mode scene change)."""
+        import contextlib
+
+        ctx = (jax.profiler.trace(profile_dir) if profile_dir
+               else contextlib.nullcontext())
+        payload = {}
+        with ctx:
+            done = 0
+            while done < frames:
+                block = min(self.cfg.runtime.scan_frames, frames - done)
+                drain_steering(self)
+                # host replay of the block's camera ladder — frame i of
+                # the scan renders with exactly this camera (orbit is
+                # applied identically in-scan)
+                cams = [self.camera]
+                for _ in range(block - 1):
+                    cams.append(orbit(cams[-1],
+                                      jnp.float32(self.orbit_rate)))
+                mxu = self._step is None
+                regime = None
+                crossing = False
+                if mxu:
+                    regimes = {self._slicer.choose_axis(c) for c in cams}
+                    crossing = len(regimes) > 1
+                # eager fallback for blocks the cached scan executable
+                # cannot serve: a regime crossing (the step is
+                # regime-specialized) or a short TAIL block (compiling a
+                # one-off scan of the whole pipeline for a different
+                # length costs far more than the frames it would save)
+                if crossing or block < self.cfg.runtime.scan_frames:
+                    if crossing:
+                        self.log(f"scan_frames: march regime crossing "
+                                 f"inside a {block}-frame block — running "
+                                 "it eagerly")
+                    for _ in range(block):
+                        out = self.render_frame()
+                        if fetch:
+                            payload = self._fetch(self.frame_index - 1,
+                                                  out)
+                        self.timers.frame_done()
+                    done += block
+                    continue
+                if mxu:
+                    regime = next(iter(regimes))
+                    if self._temporal:
+                        self._enter_regime(regime)
+                runner, seed = self._scan_runner(block, regime)
+                with self.timers.phase("dispatch"):
+                    args = (self.sim.state, self._origin, self._spacing,
+                            self.camera, jnp.float32(self.orbit_rate))
+                    if self._temporal:
+                        thr = self._mxu_thr.get(regime)
+                        if thr is None:
+                            field = shard_volume(self.sim.field, self.mesh)
+                            thr = seed(field, self._origin, self._spacing,
+                                       self.camera)
+                        (st, cam, thr2), outs = runner(*args, thr)
+                        self._mxu_thr[regime] = thr2
+                    else:
+                        (st, cam, _), outs = runner(*args)
+                self.sim.state = st
+                self.camera = cam
+                start = self.frame_index
+                self.frame_index += block
+                if fetch:
+                    vdi = outs[0] if mxu else outs
+                    metas = outs[1] if mxu else None
+                    with self.timers.phase("fetch"):
+                        color = np.asarray(vdi.color)
+                        depth = np.asarray(vdi.depth)
+                    for i in range(block):
+                        idx = start + i
+                        if metas is not None:
+                            meta = jax.tree_util.tree_map(
+                                lambda x, i=i: x[i], metas)
+                            meta = meta._replace(index=jnp.int32(idx))
+                        else:
+                            meta = self.frame_metadata(idx, camera=cams[i])
+                        payload = {"vdi_color": color[i],
+                                   "vdi_depth": depth[i],
+                                   "frame": idx, "meta": meta}
+                        with self.timers.phase("sinks"):
+                            for s in self.sinks:
+                                s(idx, payload)
+                        self.timers.frame_done()
+                else:
+                    for _ in range(block):
+                        self.timers.frame_done()
+                done += block
+        return payload
 
     def prewarm_regimes(self, regimes=None) -> dict:
         """Precompile the distributed MXU step for each (axis, sign) march
@@ -627,20 +807,22 @@ class InSituSession:
             self._mxu_steps[regime] = step
         return step
 
-    def frame_metadata(self, index: int):
+    def frame_metadata(self, index: int, camera: Optional[Camera] = None):
         """VDIMetadata for the current camera/volume placement (≅ the
         per-frame VDIData the reference builds, DistributedVolumes.kt:
-        706-716). NOTE: built from the CURRENT camera — call before the
-        camera advances for exact correspondence."""
+        706-716). NOTE: built from the CURRENT camera (or the explicit
+        ``camera`` — the scan path replays the block's camera ladder) —
+        call before the camera advances for exact correspondence."""
         from scenery_insitu_tpu.core.camera import (projection_matrix,
                                                     view_matrix)
         from scenery_insitu_tpu.core.vdi import VDIMetadata
+        camera = camera if camera is not None else self.camera
         r = self.cfg.render
         shape = (np.asarray(self.sim.field.shape)
                  if hasattr(self.sim, "field") else np.zeros(3, np.int32))
         return VDIMetadata.create(
-            projection=projection_matrix(self.camera, r.width, r.height),
-            view=view_matrix(self.camera),
+            projection=projection_matrix(camera, r.width, r.height),
+            view=view_matrix(camera),
             volume_dims=np.asarray(shape[::-1], np.float32),   # (x, y, z)
             window_dims=(r.width, r.height),
             nw=float(self._spacing[0]), index=index)

@@ -53,8 +53,13 @@ class VDIPublisher:
 
     def __init__(self, bind: str = "tcp://*:6655", codec: str = "zstd",
                  level: int = -1):
+        from scenery_insitu_tpu.io.vdi_io import resolve_codec
+
         zmq = _zmq()
-        self.codec = codec
+        # degrade the default codec when the optional zstandard package
+        # is absent (the resolved name travels in every frame header, so
+        # subscribers stay consistent)
+        self.codec = resolve_codec(codec)
         self.level = level
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.PUB)

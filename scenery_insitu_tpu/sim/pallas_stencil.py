@@ -146,20 +146,47 @@ def pick_tz(shape, t_steps: int = 1) -> int:
     return cands[0] if cands else 0
 
 
+def _probe_pick(shape, t: int, cands, probe, interpret: bool):
+    """Auto-pick walk shared by step_pallas/step_pallas2d: on TPU each
+    budget-screened candidate must pass its Mosaic compile probe before
+    being chosen (the screen is a heuristic; Mosaic is the authority —
+    an unprobed auto-pick could hand a direct caller a compile-time
+    resource error the production path would have degraded around)."""
+    if not cands:
+        raise ValueError(
+            f"grid {shape} does not fit the VMEM budget at T={t}")
+    if jax.default_backend() == "tpu" and not interpret:
+        for c in cands:
+            if probe(c):
+                return c
+        raise ValueError(
+            f"Mosaic rejected every fused-stencil candidate for grid "
+            f"{shape} at T={t} — use multi_step_pallas (degrades to "
+            f"smaller T / the XLA roll path)")
+    return cands[0]
+
+
 @functools.partial(jax.jit, static_argnames=("t_steps", "interpret", "tz"))
 def step_pallas(u: jnp.ndarray, v: jnp.ndarray, params_vec: jnp.ndarray,
                 t_steps: int = 1, interpret: bool = False, tz: int = 0):
     """Advance ``t_steps`` Gray-Scott steps in one fused kernel pass.
     ``params_vec = [f, k, du, dv, dt]`` (f32[5]). Requires
     ``pick_tz(u.shape, t_steps) > 0``. ``tz=0`` auto-picks the largest
-    nominally-fitting slab; an explicit tz must come from
-    `tz_candidates`."""
+    nominally-fitting slab that passes the Mosaic probe (on TPU); an
+    explicit tz must satisfy ``t_steps | tz | D``."""
     d, h, w = u.shape
     t = t_steps
-    tz = tz or pick_tz(u.shape, t)
-    if tz == 0:
-        raise ValueError(
-            f"grid {u.shape} does not fit the VMEM budget at T={t}")
+    if tz:
+        # explicit tz: enforce the tz_candidates constraints instead of
+        # silently leaving output tiles unwritten (grid floor-division)
+        if d % tz or tz % t:
+            raise ValueError(
+                f"explicit tz={tz} violates T | tz | D for grid {u.shape} "
+                f"at T={t} (need d % tz == 0 and tz % t_steps == 0)")
+    else:
+        tz = _probe_pick(u.shape, t, tz_candidates(u.shape, t),
+                         lambda tz_: _compile_ok(u.shape, t, tz_),
+                         interpret)
     nb = d // tz
     nb_t = d // t                 # array length in halo-block units
 
@@ -305,18 +332,27 @@ def tile2d_candidates(shape, t_steps: int = 1) -> tuple:
                    static_argnames=("t_steps", "interpret", "tz", "th"))
 def step_pallas2d(u, v, params_vec, t_steps: int = 1,
                   interpret: bool = False, tz: int = 0, th: int = 0):
-    """Advance ``t_steps`` steps in one 2D-blocked fused pass. (tz, th)
-    must come from `tile2d_candidates` (0 auto-picks the best nominal
-    fit)."""
+    """Advance ``t_steps`` steps in one 2D-blocked fused pass. An
+    explicit (tz, th) must satisfy ``T | tz | D`` and ``T | th | H``
+    (the `tile2d_candidates` constraints); (0, 0) auto-picks the
+    lowest-traffic tile that passes the Mosaic probe (on TPU)."""
     d, h, w = u.shape
     t = t_steps
-    if not (tz and th):
-        cands = tile2d_candidates(u.shape, t)
-        if not cands:
+    if tz or th:
+        # explicit tile: a value off the T | tz | D / T | th | H lattice
+        # makes grid=(d//tz, h//th) floor-divide and silently leaves part
+        # of the output unwritten — reject it loudly instead
+        if not (tz and th):
+            raise ValueError("pass both tz and th (or neither)")
+        if d % tz or h % th or tz % t or th % t:
             raise ValueError(
-                f"grid {u.shape} has no 2D tile fitting the VMEM screen "
-                f"at T={t}")
-        tz, th = cands[0]
+                f"explicit (tz={tz}, th={th}) violates T | tz | D and "
+                f"T | th | H for grid {u.shape} at T={t} (need d % tz == "
+                f"0, h % th == 0, tz % t_steps == 0, th % t_steps == 0)")
+    else:
+        tz, th = _probe_pick(
+            u.shape, t, tile2d_candidates(u.shape, t),
+            lambda c: _compile2d_ok(u.shape, t, c[0], c[1]), interpret)
     nzb, nhb = d // tz, h // th
     nz_t, nh_t = d // t, h // t    # array length in halo-block units
     rz, rh = tz // t, th // t
@@ -366,6 +402,36 @@ def _compile2d_ok(shape, t_steps: int, tz: int, th: int) -> bool:
             ok = False
         _PROBE_CACHE[key] = ok
     return ok
+
+
+def modeled_sim_traffic(shape, n: int, fused: bool = True) -> float:
+    """Modeled HBM bytes for ``n`` Gray-Scott steps under the schedules
+    `multi_step_pallas` would pick (budget screen only — probe-free, so
+    usable off-TPU), for the bench harness's traffic-model fallback and
+    the per-lever A/B accounting. ``fused=False`` (or any remainder no
+    fused schedule covers) charges the roll formulation's floor: one
+    read + one write of u and v per step."""
+    d, h, w = shape
+    vol_bytes = 2 * 4.0 * d * h * w          # u + v, f32
+    total = 0.0
+    remaining = n
+    if fused:
+        for t in range(min(_FUSE_T, n), 0, -1):
+            reps = remaining // t
+            if reps == 0:
+                continue
+            sched = _best_schedule(shape, t, on_tpu=False)
+            if sched is None:
+                continue
+            kind, tz, th = sched
+            amp = ((tz + 2 * t) * (th + 2 * t) / (tz * th) if kind == "2d"
+                   else (tz + 2 * t) / tz)
+            total += reps * (amp + 1.0) * vol_bytes   # per T-step pass
+            remaining -= reps * t
+            if remaining == 0:
+                break
+    total += remaining * 2.0 * vol_bytes
+    return total
 
 
 def _best_schedule(shape, t: int, on_tpu: bool):

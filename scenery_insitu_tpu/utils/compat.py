@@ -1,0 +1,50 @@
+"""Version-compat shims over JAX APIs that moved between releases.
+
+ONE place that knows both spellings, imported everywhere (pipelines,
+benchmarks, tests), so a JAX upgrade/downgrade is a one-file fix instead
+of a grep across the tree:
+
+- ``shard_map``: ``jax.shard_map`` (jax >= 0.8, ``check_vma=``) vs
+  ``jax.experimental.shard_map.shard_map`` (older, ``check_rep=``). The
+  shim exposes the NEW spelling (``check_vma``) and translates down.
+- ``tpu_compiler_params``: ``pltpu.CompilerParams`` vs the older
+  ``pltpu.TPUCompilerParams``.
+- ``axis_size``: ``jax.lax.axis_size`` vs the ``psum(1, axis)`` idiom
+  on JAX versions that predate it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_NEW_SHARD_MAP = getattr(jax, "shard_map", None)
+if _NEW_SHARD_MAP is None:
+    from jax.experimental.shard_map import shard_map as _OLD_SHARD_MAP
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True, **kw):
+    """``jax.shard_map`` with the new keyword surface on every supported
+    JAX: ``check_vma`` maps onto the legacy ``check_rep`` (same meaning —
+    skip the replication/varying-manual-axes output check)."""
+    if _NEW_SHARD_MAP is not None:
+        return _NEW_SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma,
+                              **kw)
+    return _OLD_SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)
+
+
+def axis_size(axis_name) -> int:
+    """Size of a mapped mesh axis inside shard_map/pmap tracing."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kw):
+    """Build Pallas TPU compiler params under either class name."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)

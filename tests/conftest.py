@@ -27,3 +27,15 @@ except Exception:
 
 assert jax.default_backend() == "cpu"
 assert jax.device_count() == 8, jax.devices()
+
+# Persistent XLA compile cache (the same helper bench.py uses): on a
+# small CPU host the tier-1 wall clock is dominated by jit compiles of
+# the distributed steps, and repeat runs — the common case for the
+# verify loop — skip them entirely. Harmless when cold.
+try:
+    from scenery_insitu_tpu.utils.backend import enable_compile_cache
+
+    enable_compile_cache()
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+except Exception:
+    pass

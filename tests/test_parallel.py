@@ -9,6 +9,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from scenery_insitu_tpu.config import CompositeConfig, RenderConfig, VDIConfig
+from scenery_insitu_tpu.utils.compat import shard_map
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.transfer import TransferFunction
 from scenery_insitu_tpu.core.vdi import render_vdi_same_view
@@ -45,7 +46,7 @@ def test_halo_exchange_matches_global():
     d = 8
     data = jnp.arange(d * 2 * 2, dtype=jnp.float32).reshape(d, 2, 2)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda x: halo_exchange_z(x),
         mesh=mesh, in_specs=P("ranks", None, None),
         out_specs=P("ranks", None, None), check_vma=False))

@@ -35,6 +35,10 @@ def test_codec_roundtrip(codec):
         from scenery_insitu_tpu.io import lz4
         if not lz4.available():
             pytest.skip("no C++ toolchain for the native lz4 codec")
+    if codec == "zstd":
+        from scenery_insitu_tpu.io.vdi_io import have_zstd
+        if not have_zstd():
+            pytest.skip("optional zstandard package not installed")
     data = np.random.default_rng(0).standard_normal(1000).astype(np.float32)
     blob = compress(data.tobytes(), codec)
     assert decompress(blob, codec) == data.tobytes()

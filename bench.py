@@ -109,23 +109,21 @@ def _peak_hbm(device_kind: str, platform: str):
     return _kind_lookup(_PEAK_HBM_GBPS, device_kind, platform, 819.0)
 
 
-def _frame_bytes_accessed(jitted, *args):
-    """HBM bytes one frame touches, from XLA's own cost analysis of the
-    compiled executable (``bytes accessed`` covers operand + output + HLO
-    intermediate traffic as the compiler scheduled it). Returns (bytes,
-    source) or (None, None); the caller falls back to a min-traffic
-    model. Lowering here hits the jit/persistent compile cache — the
-    warmup call already compiled this exact (shapes, donations) step."""
-    try:
-        ca = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        b = float(ca.get("bytes accessed", 0.0))
-        return (b, "xla_cost_analysis") if b > 0 else (None, None)
-    except Exception as e:
-        print(f"[bench] cost analysis unavailable ({type(e).__name__}: "
-              f"{str(e)[:120]})", file=sys.stderr, flush=True)
-        return None, None
+def _frame_cost(jitted, *args):
+    """Cost-analysis snapshot of the compiled frame (bytes/flops) —
+    shared implementation in obs/device.py; the caller falls back to a
+    min-traffic model when the backend reports nothing. Lowering hits
+    the jit/persistent compile cache — the warmup call already compiled
+    this exact (shapes, donations) step."""
+    from scenery_insitu_tpu.obs.device import cost_snapshot
+
+    snap = cost_snapshot(jitted, *args)
+    if snap is None or "bytes_accessed" not in snap:
+        err = (snap or {}).get("error", "no cost analysis")
+        print(f"[bench] cost analysis unavailable ({err})",
+              file=sys.stderr, flush=True)
+        return None, None, snap
+    return snap["bytes_accessed"], snap["source"], snap
 
 
 def _model_frame_bytes(grid: int, sim_steps: int, marches: int,
@@ -160,6 +158,7 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from scenery_insitu_tpu import obs
     from scenery_insitu_tpu.utils.backend import enable_compile_cache
 
     # repeat runs (driver retries, the platform fallback chain) skip the
@@ -219,6 +218,8 @@ def main():
     if ad_mode == "temporal" and engine != "mxu":
         print("[bench] temporal mode is mxu-only; using histogram",
               file=sys.stderr, flush=True)
+        obs.degrade("bench.adaptive_mode", "temporal", "histogram",
+                    "temporal mode is mxu-only", warn=False)
         ad_mode = "histogram"
 
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
@@ -353,6 +354,8 @@ def main():
         if scan_frames:
             print("[bench] SCAN_FRAMES needs temporal mxu mode; ignoring",
                   file=sys.stderr, flush=True)
+            obs.degrade("bench.scan_frames", "scan", "eager",
+                        "SCAN_FRAMES needs temporal mxu mode", warn=False)
             scan_frames = 0
         t0 = time.perf_counter()
         for i in range(frames):
@@ -392,7 +395,7 @@ def main():
     # when available; a stated lower-bound traffic model otherwise.
     frame_args = ((u, v, jnp.float32(0.0), thr) if temporal
                   else (u, v, jnp.float32(0.0)))
-    hbm_bytes, hbm_src = _frame_bytes_accessed(frame, *frame_args)
+    hbm_bytes, hbm_src, cost_snap = _frame_cost(frame, *frame_args)
     if hbm_bytes is None and engine == "mxu":
         # the model charges a full-volume read per march — a floor only
         # for the slice march; the gather engine's traffic is sample-
@@ -437,6 +440,15 @@ def main():
                           if hbm_gbps and peak_bw else None),
         "hbm_bytes_per_frame": round(hbm_bytes) if hbm_bytes else None,
         "hbm_bytes_source": hbm_src,
+        # observability (ISSUE 3): the per-regime device-cost snapshot of
+        # the compiled frame and the fallback ledger, so the artifact
+        # records WHY a number is what it is — every degradation (codec,
+        # fold probe, sim stencil, scan mode, platform) that fired in
+        # this child is listed, machine-readable
+        "cost_analysis": {
+            (f"regime={slicer.choose_axis(base)}" if engine == "mxu"
+             else "gather"): cost_snap},
+        "degradations": obs.ledger(),
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "sim_fused": sim_fused,
@@ -578,6 +590,19 @@ def _orchestrate():
                 # framework's speed; with this it reads as an outage),
                 # and the newest committed hardware truth for comparison
                 result["failed_attempts"] = errors
+                # same facts in fallback-ledger shape, merged with the
+                # child's own ledger: the run was CONFIGURED for the
+                # earlier platform entries and actually ran on this one
+                # (previously "tunnel dead or hung" lived only in the
+                # stdout tail of the artifact)
+                from scenery_insitu_tpu import obs
+
+                for e in errors:
+                    obs.degrade("bench.platform",
+                                e.split(":", 1)[0], platform, e,
+                                warn=False)
+                result["degradations"] = (
+                    result.get("degradations") or []) + obs.ledger()
                 hw = _latest_hw()
                 if hw:
                     result["latest_hw"] = hw
@@ -585,6 +610,11 @@ def _orchestrate():
             return
         errors.append(err)
         print(f"[bench] attempt failed: {err}", file=sys.stderr, flush=True)
+    from scenery_insitu_tpu import obs
+
+    for e in errors:
+        obs.degrade("bench.platform", e.split(":", 1)[0], "none", e,
+                    warn=False)
     out = {
         "metric": f"gray_scott_{grid}c_vdi_fps",
         "grid_note": "default = 512 on tpu, 128 on cpu",
@@ -592,6 +622,7 @@ def _orchestrate():
         "unit": "frames/s",
         "vs_baseline": None,
         "error": "; ".join(errors)[-800:],
+        "degradations": obs.ledger(),
     }
     hw = _latest_hw()
     if hw:

@@ -92,6 +92,8 @@ def main():
     spacing = jnp.full((3,), 2.0 / g, jnp.float32)
 
     # --------------------------------------------------- split-stage fns
+    from scenery_insitu_tpu import obs
+
     sim_fused = bool(args.sim_fused)
     if sim_fused and n > 1:
         # the fused Pallas stencil's periodic wrap is per-buffer, so it
@@ -101,6 +103,9 @@ def main():
         print("[phase_bench] --sim-fused needs a 1-rank mesh (the Pallas "
               "stencil is not partitionable); using the roll path",
               file=sys.stderr)
+        obs.degrade("phase_bench.sim_fused", "pallas", "xla_roll",
+                    "fused stencil needs a 1-rank mesh (periodic wrap "
+                    "is per-buffer)", warn=False)
         sim_fused = False
     advance = gs.multi_step_fast if sim_fused else gs.multi_step
     sim_fn = jax.jit(lambda u, v: advance(
@@ -205,6 +210,8 @@ def main():
     # the fused step covers generate+all_to_all+composite ONLY (sim runs
     # before it, gather after) — compare like with like
     split_render = sum(ms[k] for k in ("generate", "all_to_all", "composite"))
+    from scenery_insitu_tpu.obs.device import cost_snapshot
+
     print(json.dumps({
         "metric": f"phase_breakdown_{n}ranks_{g}c",
         "unit": "ms/frame",
@@ -216,6 +223,11 @@ def main():
                    "sim_fused": sim_fused,    # EFFECTIVE (multi-rank
                    "scan_frames": args.scan_frames,  # downgrades to roll)
                    "scanloop_ms_per_frame": scan_ms},
+        # device-cost truth + everything that did not run as configured
+        # (same record shape bench.py embeds — see docs/OBSERVABILITY.md)
+        "cost_analysis": {"fused_step": cost_snapshot(
+            fused, v, origin, spacing, cam)},
+        "degradations": obs.ledger(),
         "backend": jax.default_backend(),
     }))
 

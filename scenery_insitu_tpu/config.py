@@ -226,6 +226,28 @@ class RuntimeConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability / telemetry (scenery_insitu_tpu/obs — structured
+    spans, device counters, the fallback ledger; docs/OBSERVABILITY.md).
+
+    Disabled (the default) the recorder is a no-op shell around the
+    per-phase Timers: no events are recorded and no files are written —
+    the PR-1 hot path. Enabled, every session phase becomes a structured
+    span (frame/rank attribution) and ``Session.run`` flushes the
+    configured sinks at the end of the loop."""
+
+    enabled: bool = False
+    # Chrome-trace / Perfetto JSON ("" = don't write). Open the file at
+    # ui.perfetto.dev; complements the device-side profiler dir that
+    # ``Session.run(profile_dir=...)`` captures.
+    trace_path: str = ""
+    # JSONL event stream + final summary line ("" = don't write).
+    metrics_path: str = ""
+    # Timer window for the embedded Timers (0 = runtime.stats_window).
+    window: int = 0
+
+
+@dataclass(frozen=True)
 class StreamConfig:
     """Steering / streaming endpoints (≅ ZMQ :6655 + UDP :3337,
     VolumeFromFileExample.kt:840-854; DistributedVolumeRenderer.kt:278-283)."""
@@ -246,6 +268,7 @@ class FrameworkConfig:
     sim: SimConfig = field(default_factory=SimConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     # ------------------------------------------------------------------ IO
     def to_dict(self) -> dict:
@@ -287,10 +310,9 @@ class FrameworkConfig:
                 # cannot be errors — only unknown KEYS of real sections are
                 continue
             if tuple(parts) in _REMOVED_KEYS:
-                import warnings
-                warnings.warn(f"config key {name} was removed "
-                              f"({_REMOVED_KEYS[tuple(parts)]}); ignored",
-                              stacklevel=2)
+                from scenery_insitu_tpu import obs
+                obs.degrade("config.removed_key", name, "ignored",
+                            _REMOVED_KEYS[tuple(parts)])
                 continue
             try:
                 cfg = _assign(cfg, parts, _parse_value(raw))

@@ -105,10 +105,12 @@ def resolve_codec(codec: str) -> str:
     at unpack for symmetry; raw compress()/decompress() stay strict —
     data already written as zstd genuinely needs the module."""
     if codec == "zstd" and not have_zstd():
-        import warnings
-        warnings.warn("zstandard is not installed; writing zlib instead "
-                      "(install zstandard for the default codec)",
-                      stacklevel=3)
+        from scenery_insitu_tpu import obs
+
+        # ledger + the same one-time warning the inline site emitted
+        obs.degrade("io.vdi_codec", "zstd", "zlib",
+                    "zstandard is not installed (install zstandard for "
+                    "the default codec)", stacklevel=3)
         return "zlib"
     return codec
 

@@ -1,0 +1,15 @@
+"""Observability layer: structured spans, device counters, the fallback
+ledger and Perfetto/JSONL exporters (docs/OBSERVABILITY.md).
+
+Import surface is intentionally tiny and JAX-free so hot modules
+(ops/*, io/*) can ``from scenery_insitu_tpu import obs`` at module load
+without cost or cycles; ``obs.device`` (the cost-analysis snapshot)
+touches JAX only inside its functions.
+"""
+
+from scenery_insitu_tpu.obs.recorder import (Recorder, clear_ledger,
+                                             degrade, get_recorder,
+                                             ledger, set_recorder)
+
+__all__ = ["Recorder", "degrade", "ledger", "clear_ledger",
+           "get_recorder", "set_recorder"]

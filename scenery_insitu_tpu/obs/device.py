@@ -1,0 +1,43 @@
+"""Device-side enrichment: what the compiled executable says about
+itself. Host spans time the wall clock; XLA's ``cost_analysis()`` of the
+compiled step says how many HBM bytes and FLOPs the frame moves — the
+two together make a BENCH delta attributable (compute-bound vs
+bandwidth-bound vs dispatch-bound) without xprof archaeology.
+
+Lifted out of ``bench.py`` so the session, the bench harness and the
+phase diagnostics all read the same snapshot shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+def cost_snapshot(jitted, *args) -> Optional[Dict[str, Any]]:
+    """XLA cost-analysis snapshot of ``jitted(*args)``: ``bytes_accessed``
+    (operand + output + scheduled HLO intermediate traffic), ``flops``
+    and ``transcendentals`` when the backend reports them. Returns None
+    when the backend's analysis is empty/absent, and an
+    ``{"source": "unavailable", "error": ...}`` record when lowering or
+    compilation raises — callers wanting a traffic-model fallback should
+    branch on ``snap is None or "bytes_accessed" not in snap``.
+
+    Lowering hits the jit/persistent compile cache, so calling this after
+    the warmup frame costs no fresh compilation."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not ca:
+            return None
+        snap = {"source": "xla_cost_analysis"}
+        for key, out in (("bytes accessed", "bytes_accessed"),
+                         ("flops", "flops"),
+                         ("transcendentals", "transcendentals")):
+            v = ca.get(key)
+            if v is not None and float(v) > 0:
+                snap[out] = float(v)
+        return snap if len(snap) > 1 else None
+    except Exception as e:                     # noqa: BLE001 — best-effort
+        return {"source": "unavailable",
+                "error": f"{type(e).__name__}: {str(e)[:120]}"}

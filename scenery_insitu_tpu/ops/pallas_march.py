@@ -143,14 +143,16 @@ def _pick_block_w(w: int, bytes_per_col: int) -> int:
         return w
     wb = (_VMEM_STRIP_BUDGET // bytes_per_col) // 128 * 128
     if wb < 128:
-        import warnings
+        from scenery_insitu_tpu import obs
 
-        warnings.warn(
-            f"pallas_march strip needs {bytes_per_col * 128 / 2**20:.1f} MB "
-            "VMEM at the 128-lane minimum block width — over the "
-            f"{_VMEM_STRIP_BUDGET / 2**20:.0f} MB budget; compiling at the "
-            "floor anyway (Mosaic may reject it; the fold probe / auto "
-            "mode falls back to the XLA fold)", stacklevel=3)
+        obs.degrade(
+            "ops.pallas_march.block_width", "budgeted strip",
+            "128-lane floor",
+            f"strip needs {bytes_per_col * 128 / 2**20:.1f} MB VMEM at "
+            "the 128-lane minimum block width — over the "
+            f"{_VMEM_STRIP_BUDGET / 2**20:.0f} MB budget; compiling at "
+            "the floor anyway (Mosaic may reject it; the fold probe / "
+            "auto mode falls back to the XLA fold)", stacklevel=3)
     return max(128, min(wb, w))
 
 
@@ -478,13 +480,13 @@ def count_compile_ok(bins: int = 32, chunk: int = 16,
                              sds((b,), jnp.float32)).compile()
             ok = True
         except Exception as e:
-            import warnings
+            from scenery_insitu_tpu import obs
 
-            warnings.warn(
-                f"Pallas counting kernel rejected at bins={bins} "
+            obs.degrade(
+                "ops.count_fold", "pallas_count", "xla",
+                f"Mosaic rejected the counting kernel at bins={bins} "
                 f"chunk={chunk} width={width} ({type(e).__name__}: "
-                f"{str(e)[:200]}) — auto fold falls back to an XLA "
-                "schedule.", stacklevel=2)
+                f"{str(e)[:200]})")
             ok = False
         _COUNT_PROBE[key] = ok
     return ok
@@ -530,14 +532,15 @@ def fold_compile_ok(max_k: int = 32, chunk: int = 16,
                 sds((h, w), jnp.float32), sds((h, w), jnp.int32)).compile()
             ok = True
         except Exception as e:
-            import warnings
+            from scenery_insitu_tpu import obs
 
-            warnings.warn(
-                f"Pallas march fold rejected at k={max_k} chunk={chunk} "
-                f"width={width} ({type(e).__name__}: {str(e)[:200]}) — "
-                "falling back to the XLA fold schedule. If this was a "
-                "transient backend error, restart the process or set "
-                "fold='pallas' explicitly.", stacklevel=2)
+            obs.degrade(
+                "ops.march_fold", "pallas", "xla",
+                f"Mosaic rejected the march fold at k={max_k} "
+                f"chunk={chunk} width={width} ({type(e).__name__}: "
+                f"{str(e)[:200]}). If this was a transient backend "
+                "error, restart the process or set fold='pallas' "
+                "explicitly.")
             ok = False
         _FOLD_PROBE[key] = ok
     return ok

@@ -580,12 +580,12 @@ def seg_compile_ok(max_k: int = 32, chunk: int = 16,
                 sds((h, w), jnp.float32)).compile()
             ok = True
         except Exception as e:
-            import warnings
+            from scenery_insitu_tpu import obs
 
-            warnings.warn(
-                f"Pallas seg fold rejected at k={max_k} chunk={chunk} "
-                f"width={width} ({type(e).__name__}: {str(e)[:200]}) — "
-                "falling back to the XLA seg fold.", stacklevel=2)
+            obs.degrade(
+                "ops.seg_fold", "pallas_seg", "seg",
+                f"Mosaic rejected the seg fold at k={max_k} chunk={chunk} "
+                f"width={width} ({type(e).__name__}: {str(e)[:200]})")
             ok = False
         _PROBE[key] = ok
     return ok

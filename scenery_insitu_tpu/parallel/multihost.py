@@ -127,6 +127,60 @@ def gather_vdi_compressed(vdi, codec: str = "zstd"
     return np.concatenate(cols, -1), np.concatenate(deps, -1)
 
 
+def gather_obs_events(recorder) -> Optional[list]:
+    """Rank-0 merge of the observability layer (obs.Recorder): every
+    process contributes its structured events + summary (rank is already
+    in every event, so the merge is a concatenation sorted by timestamp);
+    returns the merged event list on process 0, None elsewhere. Single-
+    process: a plain local snapshot, no collective. The blob rides the
+    same padded-allgather transport as ``gather_vdi_compressed`` — zlib
+    (stdlib, never degrades) since telemetry JSON is small.
+
+    Each rank's ``ts`` is relative to its OWN recorder epoch, so the
+    merge rebases every event onto the earliest epoch (via the
+    recorder's wall-clock ``epoch_unix``) before sorting — without this,
+    a rank whose session started late would sort seconds early."""
+    import json as _json
+    import zlib
+
+    import jax
+
+    payload = {"events": recorder.events, "summary": recorder.summary(),
+               "epoch_unix": recorder.epoch_unix}
+    if jax.process_count() == 1:
+        return sorted(payload["events"], key=lambda e: e.get("ts", 0.0)) \
+            + [{"type": "summary", **payload["summary"]}]
+
+    from jax.experimental import multihost_utils
+
+    blob = zlib.compress(_json.dumps(payload).encode())
+    ln = np.zeros((1,), np.int64)
+    ln[0] = len(blob)
+    lengths = multihost_utils.process_allgather(ln)
+    maxlen = int(lengths.max())
+    buf = np.zeros((1, maxlen), np.uint8)
+    buf[0, :len(blob)] = np.frombuffer(blob, np.uint8)
+    blobs = multihost_utils.process_allgather(buf)
+
+    if jax.process_index() != 0:
+        return None
+    payloads = []
+    for p in range(jax.process_count()):
+        raw = zlib.decompress(bytes(blobs[p, 0, :int(lengths[p, 0])]))
+        payloads.append(_json.loads(raw))
+    base = min(d["epoch_unix"] for d in payloads)
+    events, summaries = [], []
+    for d in payloads:
+        shift = d["epoch_unix"] - base
+        for ev in d["events"]:
+            ev = dict(ev)
+            ev["ts"] = ev.get("ts", 0.0) + shift
+            events.append(ev)
+        summaries.append({"type": "summary", **d["summary"]})
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events + summaries
+
+
 # --------------------------------------------------------------- smoke test
 
 def _worker(coordinator: str, nproc: int, pid: int) -> None:

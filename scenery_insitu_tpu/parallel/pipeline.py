@@ -574,7 +574,15 @@ def frame_scan(step, advance, frames: int, temporal: bool = False,
     MXU engine, march-regime changes) can only take effect at block
     boundaries — the caller owns that check.
     """
+    from scenery_insitu_tpu import obs as _obs
     from scenery_insitu_tpu.core.camera import orbit as _orbit
+
+    # host-side build marker: every frame_scan() call mints one scanned
+    # executable per (step, block) — the trace correlates a dispatch
+    # stall with this rather than with the frames inside the block
+    rec = _obs.get_recorder()
+    rec.count("frame_scan_builds")
+    rec.event("frame_scan_build", frames=frames, temporal=temporal)
 
     def run(state, origin, spacing, cam, orbit_rate, thr=None):
         def body(carry, _):

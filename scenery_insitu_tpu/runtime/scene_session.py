@@ -20,11 +20,11 @@ from typing import Callable, List, Optional, Sequence
 import jax
 import numpy as np
 
+from scenery_insitu_tpu import obs as _obs
 from scenery_insitu_tpu.config import FrameworkConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.scene import MultiGridScene
 from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
-from scenery_insitu_tpu.runtime.timers import Timers
 
 Sink = Callable[[int, dict], None]
 
@@ -37,8 +37,14 @@ class SceneSession:
         self.cfg = cfg or FrameworkConfig()
         self.log = log or (lambda s: None)
         self.scene = MultiGridScene()
-        self.timers = Timers(window=self.cfg.runtime.stats_window,
-                             log=self.log)
+        # same recorder-wraps-timers layering as InSituSession (spans
+        # feed the PhaseStats either way; events only when obs enabled)
+        self.obs = _obs.Recorder.from_config(
+            self.cfg.obs, rank=jax.process_index(), log=self.log,
+            window=self.cfg.runtime.stats_window)
+        self.timers = self.obs.timers
+        # always take over the process slot (see InSituSession.__init__)
+        _obs.set_recorder(self.obs)
         self.tf = tf or for_dataset(self.cfg.runtime.dataset)
         self.camera = camera or Camera.create(
             (0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.3, far=20.0)
@@ -104,7 +110,9 @@ class SceneSession:
             advance_camera_and_index, drain_steering)
 
         drain_steering(self)
-        with self.timers.phase("dispatch"):
+        with self.obs.span("dispatch", frame=self.frame_index,
+                           engine=self.engine,
+                           grids=self.scene.num_grids):
             step, key = self._step()
             gs = self.scene.grids
             args = (tuple(g.volume.data for g in gs),
@@ -120,7 +128,7 @@ class SceneSession:
                 out, self._thr[key] = step(*args, thr)
             else:
                 out = step(*args)
-        with self.timers.phase("fetch"):
+        with self.obs.span("fetch", frame=self.frame_index):
             if self.cfg.runtime.generate_vdis:
                 vdi, meta = out
                 payload = {"vdi_color": np.asarray(vdi.color),
@@ -130,12 +138,23 @@ class SceneSession:
             else:
                 payload = {"image": np.asarray(out)}
             payload["frame"] = self.frame_index
-        with self.timers.phase("sinks"):
+        with self.obs.span("sinks", frame=self.frame_index):
             for s in self.sinks:
                 s(self.frame_index, payload)
         advance_camera_and_index(self)
         self.timers.frame_done()
+        # the driver paces this loop (no run() bracket to flush at), so
+        # write the obs sinks at every stats-window boundary — flush()
+        # rewrites whole snapshots, so the files are always loadable
+        if self.frame_index % self.timers.window == 0:
+            self.obs.flush()
         return payload
+
+    def close(self) -> None:
+        """End-of-campaign teardown: flush the final partial timer
+        window + totals and write the obs sinks."""
+        self.timers.dump_totals()
+        self.obs.flush()
 
     def prewarm_regimes(self, regimes=None) -> dict:
         """Precompile the render step for each (axis, sign) camera regime
@@ -239,6 +258,9 @@ class SceneSession:
         if step is not None:
             return step, key
 
+        self.obs.count("compile_step")
+        self.obs.event("compile", frame=self.frame_index,
+                       what="scene_step", regime=str(regime))
         ghosts = [(g.ghost_lo, g.ghost_hi) for g in gs]
         r = self.cfg.render
         cfg = self.cfg

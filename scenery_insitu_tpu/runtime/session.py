@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scenery_insitu_tpu import obs as _obs
 from scenery_insitu_tpu.config import FrameworkConfig
 from scenery_insitu_tpu.core.camera import Camera, orbit
 from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
@@ -34,7 +35,6 @@ from scenery_insitu_tpu.parallel.mesh import make_mesh
 from scenery_insitu_tpu.parallel.pipeline import (distributed_plain_step,
                                                   distributed_vdi_step,
                                                   shard_volume)
-from scenery_insitu_tpu.runtime.timers import Timers
 from scenery_insitu_tpu.sim import grayscott as gs
 from scenery_insitu_tpu.sim import vortex as vx
 
@@ -48,7 +48,7 @@ def drain_steering(sess) -> None:
     if sess.steering is None:
         return
     from scenery_insitu_tpu.runtime.streaming import apply_steering
-    with sess.timers.phase("steer"):
+    with sess.obs.span("steer", frame=sess.frame_index):
         for msg in sess.steering.drain():
             sess.camera, other = apply_steering(sess.camera, msg)
             for kind_msg in other.values():
@@ -241,7 +241,18 @@ class InSituSession:
         self.log = log or (lambda s: None)
         self.mesh = mesh if mesh is not None else make_mesh(
             self.cfg.mesh.num_devices, self.cfg.mesh.axis_name)
-        self.timers = Timers(window=self.cfg.runtime.stats_window, log=self.log)
+        # the recorder wraps+subsumes the per-phase Timers: every span
+        # feeds `self.timers` (same PhaseStats/windowed dumps as before),
+        # and with obs enabled also records structured frame/rank events
+        self.obs = _obs.Recorder.from_config(
+            self.cfg.obs, rank=jax.process_index(), log=self.log,
+            window=self.cfg.runtime.stats_window)
+        self.timers = self.obs.timers
+        # ALWAYS take over the process slot (enabled or not): the
+        # library-level span/degrade sites route through get_recorder(),
+        # and a stale enabled recorder from a finished session would
+        # otherwise keep absorbing this session's events
+        _obs.set_recorder(self.obs)
         if sim is not None:
             self.sim = sim
         elif self.cfg.sim.kind in ("lennard_jones", "sho"):
@@ -290,6 +301,10 @@ class InSituSession:
         a runtime transfer-function change (the TF is a compile-time
         constant of every step)."""
         r = self.cfg.render
+        # step-cache rebuilds drop every compiled executable — counted so
+        # a trace can attribute a mid-run compile stall (e.g. a TF
+        # steering update) to its cause
+        self.obs.count("build_steps")
         self._mxu_steps = {}   # regime key -> jitted distributed step
         self._mxu_thr = {}     # regime key -> temporal threshold state
         self._scan_steps = {}  # (kind, regime, block) -> scan executable
@@ -355,9 +370,11 @@ class InSituSession:
     def render_frame(self):
         """Advance the sim and dispatch one render step (device arrays)."""
         drain_steering(self)
-        with self.timers.phase("sim"):
+        with self.obs.span("sim", frame=self.frame_index,
+                           kind=self.sim.kind):
             self.sim.advance(self.cfg.sim.steps_per_frame)
-        with self.timers.phase("dispatch"):
+        with self.obs.span("dispatch", frame=self.frame_index,
+                           mode=self.mode, engine=self.engine):
             if self.mode == "particles":
                 from scenery_insitu_tpu.parallel.particles import (
                     shard_particles)
@@ -391,6 +408,7 @@ class InSituSession:
         for k in [k for k in self._pending_meta
                   if k < self.frame_index - 1]:
             del self._pending_meta[k]
+        self.obs.count("frames_eager_dispatch")
         advance_camera_and_index(self)
         return out
 
@@ -418,6 +436,8 @@ class InSituSession:
                 return self._run_scan(frames, fetch, profile_dir)
             self.log(f"scan_frames={self.cfg.runtime.scan_frames}: "
                      f"falling back to the eager loop ({reason})")
+            _obs.degrade("session.scan_frames", "scan", "eager", reason,
+                         warn=False)
 
         ctx = (jax.profiler.trace(profile_dir) if profile_dir
                else contextlib.nullcontext())
@@ -432,11 +452,15 @@ class InSituSession:
                 self.timers.frame_done()
             if pending is not None and fetch:
                 payload = self._fetch(*pending)
+        # end-of-run teardown: the final partial window frame_done never
+        # reached, the whole-run totals, and the obs sinks
+        self.timers.dump_totals()
+        self.obs.flush()
         return payload
 
     def _fetch(self, index: int, out) -> dict:
         from scenery_insitu_tpu.ops.splat import SplatOutput
-        with self.timers.phase("fetch"):
+        with self.obs.span("fetch", frame=index):
             if isinstance(out, VDI):
                 payload = {"vdi_color": np.asarray(out.color),
                            "vdi_depth": np.asarray(out.depth)}
@@ -448,12 +472,14 @@ class InSituSession:
             payload["frame"] = index
             payload["meta"] = self._pending_meta.pop(index,
                                                      self.frame_metadata(index))
-        with self.timers.phase("sinks"):
+        with self.obs.span("sinks", frame=index):
             for s in self.sinks:
                 s(index, payload)
         return payload
 
     def _enter_regime(self, key) -> None:
+        if key != getattr(self, "_last_regime_key", key):
+            self.obs.count("regime_switches")
         drop_on_regime_reentry(self, self._mxu_thr, key)
 
     # ------------------------------------------------- frame-scan blocks
@@ -482,6 +508,11 @@ class InSituSession:
         key = ("scan", regime, block)
         entry = self._scan_steps.get(key)
         if entry is None:
+            # cache miss = one fresh scan-block jit at next dispatch
+            self.obs.count("compile_scan_block")
+            self.obs.event("compile", frame=self.frame_index,
+                           what="scan_block", regime=str(regime),
+                           block=block)
             if regime is None:
                 step, seed = self._step, None
             else:
@@ -561,6 +592,23 @@ class InSituSession:
                         self.log(f"scan_frames: march regime crossing "
                                  f"inside a {block}-frame block — running "
                                  "it eagerly")
+                        _obs.degrade(
+                            "session.scan_block", "scan", "eager",
+                            "march regime crossing inside a block",
+                            warn=False)
+                    else:
+                        # a tail block is expected on long runs, but it
+                        # still ran eagerly — the ledger must say so (a
+                        # run SHORTER than scan_frames is all tail, and
+                        # an empty ledger would read as "scan was live")
+                        self.obs.count("scan_tail_eager_frames", block)
+                        self.log(f"scan_frames: {block}-frame tail block "
+                                 "below the scan length — running it "
+                                 "eagerly")
+                        _obs.degrade(
+                            "session.scan_block", "scan", "eager",
+                            "tail block shorter than scan_frames",
+                            warn=False)
                     for _ in range(block):
                         out = self.render_frame()
                         if fetch:
@@ -574,7 +622,11 @@ class InSituSession:
                     if self._temporal:
                         self._enter_regime(regime)
                 runner, seed = self._scan_runner(block, regime)
-                with self.timers.phase("dispatch"):
+                self.obs.count("scan_blocks_dispatched")
+                self.obs.count("frames_scan_dispatch", block)
+                with self.obs.span("dispatch", frame=self.frame_index,
+                                   scan_block=block,
+                                   regime=str(regime)):
                     args = (self.sim.state, self._origin, self._spacing,
                             self.camera, jnp.float32(self.orbit_rate))
                     if self._temporal:
@@ -594,7 +646,8 @@ class InSituSession:
                 if fetch:
                     vdi = outs[0] if mxu else outs
                     metas = outs[1] if mxu else None
-                    with self.timers.phase("fetch"):
+                    with self.obs.span("fetch", frame=start,
+                                       scan_block=block):
                         color = np.asarray(vdi.color)
                         depth = np.asarray(vdi.depth)
                     for i in range(block):
@@ -608,7 +661,7 @@ class InSituSession:
                         payload = {"vdi_color": color[i],
                                    "vdi_depth": depth[i],
                                    "frame": idx, "meta": meta}
-                        with self.timers.phase("sinks"):
+                        with self.obs.span("sinks", frame=idx):
                             for s in self.sinks:
                                 s(idx, payload)
                         self.timers.frame_done()
@@ -616,6 +669,8 @@ class InSituSession:
                     for _ in range(block):
                         self.timers.frame_done()
                 done += block
+        self.timers.dump_totals()
+        self.obs.flush()
         return payload
 
     def prewarm_regimes(self, regimes=None) -> dict:
@@ -656,16 +711,17 @@ class InSituSession:
                 cam = regime_camera(cam0, regime, self._slicer)
                 self.camera = cam
                 t0 = _time.perf_counter()
-                if self.mode == "hybrid":
-                    out, _ = self._hybrid_dispatch()
-                else:
-                    field = shard_volume(self.sim.field, self.mesh)
-                    if self.mode == "plain":
-                        out = self._plain_mxu_dispatch(field)
+                with self.obs.span("prewarm", regime=str(regime)):
+                    if self.mode == "hybrid":
+                        out, _ = self._hybrid_dispatch()
                     else:
-                        out, _ = self._mxu_step()(field, self._origin,
-                                                  self._spacing, cam)
-                jax.block_until_ready(out)
+                        field = shard_volume(self.sim.field, self.mesh)
+                        if self.mode == "plain":
+                            out = self._plain_mxu_dispatch(field)
+                        else:
+                            out, _ = self._mxu_step()(field, self._origin,
+                                                      self._spacing, cam)
+                    jax.block_until_ready(out)
                 times[(a, s)] = round(_time.perf_counter() - t0, 2)
         finally:
             self.camera = cam0
@@ -693,6 +749,9 @@ class InSituSession:
             self._enter_regime(key)
         entry = self._mxu_steps.get(key)
         if entry is None:
+            self.obs.count("compile_step")
+            self.obs.event("compile", frame=self.frame_index,
+                           what="hybrid_step", regime=str(regime))
             n = self.mesh.shape[self.cfg.mesh.axis_name]
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
@@ -746,6 +805,9 @@ class InSituSession:
         key = ("plain",) + regime
         entry = self._mxu_steps.get(key)
         if entry is None:
+            self.obs.count("compile_step")
+            self.obs.event("compile", frame=self.frame_index,
+                           what="plain_step", regime=str(regime))
             n = self.mesh.shape[self.cfg.mesh.axis_name]
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
@@ -781,6 +843,9 @@ class InSituSession:
             self._enter_regime(regime)
         step = self._mxu_steps.get(regime)
         if step is None:
+            self.obs.count("compile_step")
+            self.obs.event("compile", frame=self.frame_index,
+                           what="vdi_step", regime=str(regime))
             n = self.mesh.shape[self.cfg.mesh.axis_name]
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
@@ -826,6 +891,48 @@ class InSituSession:
             volume_dims=np.asarray(shape[::-1], np.float32),   # (x, y, z)
             window_dims=(r.width, r.height),
             nw=float(self._spacing[0]), index=index)
+
+    def device_snapshot(self) -> dict:
+        """Per-regime XLA cost-analysis snapshot (bytes/flops) of every
+        compiled step this session holds, keyed like the step caches
+        (obs/device.cost_snapshot — the same numbers bench.py's roofline
+        fields use). Best-effort: steps that are host-side closures
+        (temporal mode threads threshold state in Python) or whose mode
+        takes different operands report as unavailable rather than
+        raising; lowering hits the compile cache, so this is cheap after
+        the first frame. The snapshot is also recorded as an obs event so
+        a metrics file carries the device-side truth next to the spans."""
+        from scenery_insitu_tpu.obs import device as _dev
+
+        snaps = {}
+        if self.mode in ("vdi", "plain"):
+            field = shard_volume(self.sim.field, self.mesh)
+            args = (field, self._origin, self._spacing, self.camera)
+            if self._step is not None:
+                snaps["gather" if self.mode == "vdi" else "plain"] = \
+                    _dev.cost_snapshot(self._step, *args)
+            for key, entry in self._mxu_steps.items():
+                step = entry[0] if isinstance(entry, tuple) else entry
+                if not hasattr(step, "lower"):
+                    snaps[str(key)] = {"source": "unavailable",
+                                       "error": "host-side closure "
+                                                "(temporal step)"}
+                    continue
+                snaps[str(key)] = _dev.cost_snapshot(step, *args)
+        else:
+            # hybrid/particle steps take mode-specific operands this
+            # generic path does not reconstruct — report them as
+            # unavailable rather than returning an empty dict
+            keys = (list(self._mxu_steps) if self._mxu_steps
+                    else ([self.mode] if self._step is not None else []))
+            for key in keys:
+                snaps[str(key)] = {"source": "unavailable",
+                                   "error": f"mode {self.mode!r} operands "
+                                            "not snapshotted"}
+        if snaps:
+            self.obs.event("device_snapshot", frame=self.frame_index,
+                           regimes=list(snaps))
+        return snaps
 
 
 def vdi_sink(directory: str, dataset: str = "session", every: int = 1,

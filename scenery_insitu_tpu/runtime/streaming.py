@@ -73,17 +73,22 @@ class VDIPublisher:
     def publish(self, vdi: VDI, meta: VDIMetadata) -> int:
         """Send one frame; returns wire bytes (≅ the compressed publish loop,
         VolumeFromFileExample.kt:974-1037)."""
-        color = np.ascontiguousarray(np.asarray(vdi.color))
-        depth = np.ascontiguousarray(np.asarray(vdi.depth))
-        cblob = compress(color.tobytes(), self.codec, self.level)
-        dblob = compress(depth.tobytes(), self.codec, self.level)
-        header = _msgpack().packb({
-            "codec": self.codec,
-            "color_shape": list(color.shape),
-            "depth_shape": list(depth.shape),
-            "meta": {f: np.asarray(getattr(meta, f)).tolist()
-                     for f in _META_FIELDS},
-        })
+        from scenery_insitu_tpu import obs as _obs
+
+        with _obs.get_recorder().span(
+                "encode", frame=int(np.asarray(meta.index)),
+                sink="vdi_publisher", codec=self.codec):
+            color = np.ascontiguousarray(np.asarray(vdi.color))
+            depth = np.ascontiguousarray(np.asarray(vdi.depth))
+            cblob = compress(color.tobytes(), self.codec, self.level)
+            dblob = compress(depth.tobytes(), self.codec, self.level)
+            header = _msgpack().packb({
+                "codec": self.codec,
+                "color_shape": list(color.shape),
+                "depth_shape": list(depth.shape),
+                "meta": {f: np.asarray(getattr(meta, f)).tolist()
+                         for f in _META_FIELDS},
+            })
         self.sock.send_multipart([header, cblob, dblob])
         return len(header) + len(cblob) + len(dblob)
 
@@ -317,10 +322,14 @@ class VideoStreamer:
 
         import cv2
 
-        rgb = np.clip(np.asarray(img[:3]), 0.0, 1.0) ** (1.0 / self.gamma)
-        frame = (np.moveaxis(rgb, 0, -1) * 255).astype(np.uint8)
-        ok, jpg = cv2.imencode(".jpg", frame[:, :, ::-1],
-                               [cv2.IMWRITE_JPEG_QUALITY, self.quality])
+        from scenery_insitu_tpu import obs as _obs
+
+        with _obs.get_recorder().span("encode", frame=self.frame_id,
+                                      sink="video_streamer"):
+            rgb = np.clip(np.asarray(img[:3]), 0.0, 1.0) ** (1.0 / self.gamma)
+            frame = (np.moveaxis(rgb, 0, -1) * 255).astype(np.uint8)
+            ok, jpg = cv2.imencode(".jpg", frame[:, :, ::-1],
+                                   [cv2.IMWRITE_JPEG_QUALITY, self.quality])
         if not ok:
             return 0
         blob = jpg.tobytes()

@@ -108,7 +108,27 @@ class Timers:
         for name, st in sorted(self.window_stats.items()):
             self.log(f"  {name:>16}: avg {st.avg * 1e3:8.3f} ms  "
                      f"total {st.total:7.3f} s  n={st.n}")
+        # reset so each dump is a true per-window average — without this
+        # the "windowed" lines silently accumulate over the whole run
         self.window_stats = defaultdict(PhaseStats)
+
+    def dump_totals(self) -> None:
+        """Final dump: flush the partial window frame_done never reached
+        (a 250-frame run at window=100 leaves 50 frames undumped), then
+        the whole-run totals. Idempotent on the window part."""
+        if any(st.n for st in self.window_stats.values()):
+            self.log(f"=== frame {self.frames} (final partial window) ===")
+            for name, st in sorted(self.window_stats.items()):
+                self.log(f"  {name:>16}: avg {st.avg * 1e3:8.3f} ms  "
+                         f"total {st.total:7.3f} s  n={st.n}")
+            self.window_stats = defaultdict(PhaseStats)
+        self.log(f"=== totals over {self.frames} frames ===")
+        for name, st in sorted(self.stats.items()):
+            self.log(f"  {name:>16}: avg {st.avg * 1e3:8.3f} ms  "
+                     f"total {st.total:7.3f} s  n={st.n}")
+
+    # alias so recorder/session teardown paths read naturally
+    close = dump_totals
 
     def csv(self) -> str:
         lines = ["phase;avg;min;max;stddev;n"]

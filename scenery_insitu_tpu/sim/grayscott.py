@@ -104,10 +104,23 @@ def multi_step_fast(state: GrayScott, n: int) -> GrayScott:
     `multi_step` on other backends or VMEM-oversized grids. NOT for sharded
     state — the Pallas kernel's periodic wrap is per-buffer, so use
     `multi_step` (whose rolls XLA lowers to ICI halo exchanges) there."""
+    from scenery_insitu_tpu import obs
     from scenery_insitu_tpu.sim import pallas_stencil as ps
 
-    if jax.default_backend() != "tpu" or not ps.fused_supported(
-            state.u.shape):
+    if jax.default_backend() != "tpu":
+        # ledger only (warn=False): this runs per frame and the off-TPU
+        # downgrade is expected platform behavior — but a run that was
+        # CONFIGURED fused and silently ran the roll path must still end
+        # with that fact on the record (deduped, counted)
+        obs.degrade("sim.fused_stencil", "pallas", "xla_roll",
+                    f"backend is {jax.default_backend()!r}, not tpu",
+                    warn=False)
+        return multi_step(state, n)
+    if not ps.fused_supported(state.u.shape):
+        obs.degrade("sim.fused_stencil", "pallas", "xla_roll",
+                    f"no fused-stencil schedule fits grid "
+                    f"{tuple(state.u.shape)} in the VMEM budget",
+                    warn=False)
         return multi_step(state, n)
     p = state.params
     pvec = jnp.stack([p.f, p.k, p.du, p.dv, p.dt])

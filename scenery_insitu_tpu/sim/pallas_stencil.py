@@ -454,4 +454,14 @@ def _best_schedule(shape, t: int, on_tpu: bool):
               else _compile_ok(shape, t, tz))
         if ok:
             return kind, tz, th
+    if opts:
+        from scenery_insitu_tpu import obs
+
+        # the auto-pick found budget-fitting candidates but Mosaic took
+        # none — the caller runs this T-pass on the XLA roll path;
+        # ledger-only (callers decide loudness via fused_supported)
+        obs.degrade("sim.stencil_schedule", f"fused T={t}", "xla_roll",
+                    f"Mosaic rejected all {len(opts[:3])} probed "
+                    f"schedule candidates for grid {tuple(shape)}",
+                    warn=False)
     return None

@@ -35,6 +35,12 @@ def test_bench_emits_one_parseable_json_line():
     assert d["unit"] == "frames/s"
     assert d["config"]["platform"] == "cpu"
     assert d["config"]["adaptive_mode"] == "temporal"   # bench default
+    # observability contract (ISSUE 3): every artifact embeds the
+    # fallback ledger and the device-cost snapshot of the compiled frame
+    assert "degradations" in d, d
+    assert any(e["component"] == "sim.fused_stencil"
+               for e in d["degradations"])   # CPU run degrades the stencil
+    assert "cost_analysis" in d, d
 
 
 def test_bench_reports_failed_attempts_on_fallback(tmp_path):

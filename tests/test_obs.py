@@ -1,0 +1,293 @@
+"""Observability layer (ISSUE 3): structured spans, the fallback ledger,
+Chrome-trace/JSONL export, the disabled-recorder no-op path, and the
+Timers windowed-dump reset."""
+
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+from scenery_insitu_tpu import obs
+from scenery_insitu_tpu.config import FrameworkConfig
+from scenery_insitu_tpu.obs.recorder import Recorder
+from scenery_insitu_tpu.parallel.mesh import make_mesh
+from scenery_insitu_tpu.runtime.session import InSituSession
+from scenery_insitu_tpu.runtime.timers import Timers
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_obs():
+    """Sessions with obs enabled install themselves as the process
+    recorder and degradations land in a process-global ledger — restore
+    both around every test."""
+    prev = obs.get_recorder()
+    obs.clear_ledger()
+    yield
+    obs.set_recorder(prev)
+    obs.clear_ledger()
+
+
+def _session_cfg(**kw):
+    cfg = FrameworkConfig().with_overrides(
+        "render.width=32", "render.height=24", "render.max_steps=24",
+        "vdi.max_supersegments=6", "vdi.adaptive_iters=2",
+        "composite.max_output_supersegments=8", "composite.adaptive_iters=2",
+        "sim.grid=[16,16,16]", "sim.steps_per_frame=2",
+        "runtime.stats_window=2")
+    return cfg.with_overrides(*[f"{k}={v}" for k, v in kw.items()])
+
+
+# ------------------------------------------------------------ recorder core
+
+def test_span_nesting_and_attribution():
+    rec = Recorder(enabled=True, rank=3)
+    with rec.span("frame", frame=7):
+        with rec.span("sim", frame=7, kind="gray_scott"):
+            pass
+        with rec.span("dispatch", frame=7):
+            pass
+    spans = [e for e in rec.events if e["type"] == "span"]
+    assert [s["name"] for s in spans] == ["sim", "dispatch", "frame"]
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["frame"]["depth"] == 0 and "parent" not in by_name["frame"]
+    assert by_name["sim"]["depth"] == 1
+    assert by_name["sim"]["parent"] == "frame"
+    assert by_name["sim"]["attrs"] == {"kind": "gray_scott"}
+    for s in spans:
+        assert s["frame"] == 7
+        assert s["rank"] == 3
+        assert s["dur"] >= 0.0
+    # spans feed the wrapped Timers' PhaseStats too (one sink among several)
+    assert rec.timers.stats["sim"].n == 1
+
+
+def test_counters_and_summary():
+    rec = Recorder(enabled=True)
+    rec.count("compile_step")
+    rec.count("compile_step")
+    rec.count("frames_scan_dispatch", 8)
+    s = rec.summary()
+    assert s["counters"]["compile_step"] == 2
+    assert s["counters"]["frames_scan_dispatch"] == 8
+    assert s["enabled"] is True
+    assert isinstance(s["degradations"], list)
+
+
+# ------------------------------------------------------------------- ledger
+
+def test_forced_codec_degrade_in_ledger(monkeypatch):
+    from scenery_insitu_tpu.io import vdi_io
+
+    monkeypatch.setattr(vdi_io, "have_zstd", lambda: False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert vdi_io.resolve_codec("zstd") == "zlib"
+        assert vdi_io.resolve_codec("zstd") == "zlib"
+    entries = [e for e in obs.ledger() if e["component"] == "io.vdi_codec"]
+    assert len(entries) == 1, entries
+    assert entries[0]["from"] == "zstd" and entries[0]["to"] == "zlib"
+    assert entries[0]["count"] == 2          # deduped, counted
+    # the warning the inline site used to emit still fires (once)
+    assert sum("zstandard" in str(x.message) for x in w) == 1
+
+
+def test_forced_eager_scan_fallback_in_ledger():
+    class OpaqueSim:
+        """Custom adapter: no traceable (state, advance) pair, so
+        scan_frames must degrade to the eager loop."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.kind = inner.kind
+
+        def advance(self, n):
+            self._inner.advance(n)
+
+        @property
+        def field(self):
+            return self._inner.field
+
+    from scenery_insitu_tpu.runtime.session import VolumeSimAdapter
+
+    cfg = _session_cfg(**{"runtime.scan_frames": 2})
+    sess = InSituSession(cfg, mesh=make_mesh(2),
+                         sim=OpaqueSim(VolumeSimAdapter(cfg)))
+    sess.run(2)
+    entries = [e for e in obs.ledger()
+               if e["component"] == "session.scan_frames"]
+    assert len(entries) == 1, obs.ledger()
+    assert entries[0]["from"] == "scan" and entries[0]["to"] == "eager"
+    assert "custom sim adapter" in entries[0]["reason"]
+    # the frames actually ran eagerly
+    assert sess.obs.counters.get("frames_eager_dispatch") == 2
+
+
+# ---------------------------------------------------------------- exporters
+
+def test_chrome_trace_schema(tmp_path):
+    rec = Recorder(enabled=True, rank=1)
+    with rec.span("sim", frame=0):
+        pass
+    rec.count("compile_step")
+    rec.event("compile", frame=0, what="vdi_step")
+    obs.degrade("test.component", "a", "b", "because", warn=False)
+    path = rec.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert xs, "no complete (X) span events"
+    for e in xs:
+        for key in ("ph", "ts", "dur", "pid", "name", "tid"):
+            assert key in e, (key, e)
+        assert e["pid"] == 1
+        assert e["args"]["frame"] == 0
+    assert any(e.get("ph") == "C" for e in evs)          # counter
+    assert any(e.get("cat") == "degrade" for e in evs)   # ledger instants
+    assert any(e.get("ph") == "M" for e in evs)          # process name
+
+
+def test_metrics_jsonl(tmp_path):
+    rec = Recorder(enabled=True)
+    with rec.span("sim", frame=0):
+        pass
+    path = rec.export_metrics_jsonl(str(tmp_path / "metrics.jsonl"))
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert lines[0]["type"] == "span" and lines[0]["name"] == "sim"
+    assert lines[-1]["type"] == "summary"
+    assert "degradations" in lines[-1]
+
+
+def test_disabled_recorder_noop(tmp_path):
+    trace = tmp_path / "trace.json"
+    metrics = tmp_path / "metrics.jsonl"
+    rec = Recorder(enabled=False, trace_path=str(trace),
+                   metrics_path=str(metrics))
+    for i in range(5):
+        with rec.span("sim", frame=i):
+            pass
+    rec.flush()
+    assert rec.events == []                  # zero events recorded
+    assert not trace.exists() and not metrics.exists()   # no sink writes
+    # ...but the PR-1 timer behavior is fully preserved
+    assert rec.timers.stats["sim"].n == 5
+
+
+# ------------------------------------------------------- session integration
+
+def test_session_run_writes_trace_and_metrics(tmp_path):
+    trace = tmp_path / "trace.json"
+    metrics = tmp_path / "metrics.jsonl"
+    cfg = _session_cfg(**{
+        "obs.enabled": "true",
+        "obs.trace_path": str(trace),
+        "obs.metrics_path": str(metrics)})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    sess.run(3)
+    with open(trace) as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in xs}
+    # every host-visible render phase is covered
+    assert {"sim", "dispatch", "fetch", "sinks"} <= names, names
+    frames = {e["args"].get("frame") for e in xs if e["name"] == "sim"}
+    assert frames == {0, 1, 2}
+    assert all(e["pid"] == 0 for e in xs)     # rank attribution
+    lines = [json.loads(l) for l in open(metrics) if l.strip()]
+    assert lines and lines[-1]["type"] == "summary"
+    assert lines[-1]["frames"] == 3
+    assert lines[-1]["counters"].get("frames_eager_dispatch") == 3
+
+
+def test_session_disabled_obs_zero_events():
+    sess = InSituSession(_session_cfg(), mesh=make_mesh(2))
+    sess.run(2)
+    assert sess.obs.events == []
+    assert sess.obs.enabled is False
+    assert sess.timers.stats["sim"].n == 2   # PR-1 behavior intact
+
+
+def test_session_device_snapshot():
+    sess = InSituSession(_session_cfg(), mesh=make_mesh(2))
+    sess.run(1)
+    snaps = sess.device_snapshot()
+    assert "gather" in snaps
+    snap = snaps["gather"]
+    assert snap is None or "source" in snap
+
+
+def test_gather_obs_events_single_process():
+    from scenery_insitu_tpu.parallel.multihost import gather_obs_events
+
+    rec = Recorder(enabled=True, rank=0)
+    with rec.span("sim", frame=0):
+        pass
+    merged = gather_obs_events(rec)
+    assert merged is not None
+    assert merged[0]["name"] == "sim"
+    assert merged[-1]["type"] == "summary"
+
+
+# ------------------------------------------------------------------- timers
+
+def test_window_stats_reset_between_dumps():
+    """Regression: each windowed dump must average ONLY its own window —
+    never accumulate over the whole run."""
+    lines = []
+    t = Timers(window=2, log=lines.append)
+    for _ in range(2):
+        t.record("sim", 1.0)
+        t.frame_done()
+    assert any("window of 2" in l for l in lines)
+    # reset happened: the window accumulator is empty after the dump
+    assert all(st.n == 0 for st in t.window_stats.values())
+    for _ in range(2):
+        t.record("sim", 3.0)
+        t.frame_done()
+    # second window dump shows the second window's average (3000 ms),
+    # not the accumulated 2000 ms
+    second = [l for l in lines if "sim" in l][-1]
+    assert "3000.000 ms" in second, second
+    assert t.stats["sim"].n == 4             # totals still cover the run
+
+
+def test_dump_totals_flushes_partial_window():
+    lines = []
+    t = Timers(window=100, log=lines.append)
+    for _ in range(3):                        # never reaches a boundary
+        t.record("sim", 0.5)
+        t.frame_done()
+    assert not any("window" in l for l in lines)
+    t.dump_totals()
+    assert any("final partial window" in l for l in lines)
+    assert any("totals over 3 frames" in l for l in lines)
+    # idempotent on the window part
+    n = len(lines)
+    t.close()
+    assert not any("final partial window" in l for l in lines[n:])
+
+
+def test_degrade_dedup_and_warning_once():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        obs.degrade("x.y", "fast", "slow", "why")
+        obs.degrade("x.y", "fast", "slow", "why")
+        obs.degrade("x.y", "fast", "slow", "other reason")
+    entries = [e for e in obs.ledger() if e["component"] == "x.y"]
+    assert len(entries) == 2
+    assert entries[0]["count"] == 2 and entries[1]["count"] == 1
+    assert len(w) == 2                        # one warning per distinct entry
+
+
+def test_obs_config_roundtrip():
+    cfg = FrameworkConfig().with_overrides(
+        "obs.enabled=true", "obs.trace_path=/tmp/t.json", "obs.window=7")
+    assert cfg.obs.enabled is True
+    assert cfg.obs.trace_path == "/tmp/t.json"
+    assert cfg.obs.window == 7
+    d = cfg.to_dict()
+    assert d["obs"]["enabled"] is True
+    cfg2 = FrameworkConfig.from_dict(d)
+    assert cfg2.obs == cfg.obs

@@ -210,6 +210,12 @@ def main():
     # sim-fusion lever A/B: 0 pins the XLA roll formulation (the un-fused
     # baseline the time-fused Pallas stencil is measured against)
     sim_fused = bool(_env_int("SITPU_BENCH_SIM_FUSED", 1))
+    # sort-last exchange schedule A/B (docs/PERF.md "Exchange modes"):
+    # single-chip both schedules are the identity exchange, so this knob
+    # exists to keep the flagship config in lockstep with the distributed
+    # A/B in benchmarks/composite_bench.py (which measures the virtual
+    # mesh) and to carry the choice into the artifact's config block
+    exchange = os.environ.get("SITPU_BENCH_EXCHANGE", "all_to_all")
 
     from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
@@ -232,7 +238,8 @@ def main():
             vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,
                               adaptive_mode=ad_mode),
             comp_cfg=CompositeConfig(max_output_supersegments=k,
-                                     adaptive_iters=ad_iters),
+                                     adaptive_iters=ad_iters,
+                                     exchange=exchange),
             engine=engine, grid_shape=(grid, grid, grid),
             axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
             slicer_cfg=mc, render_dtype=render_dtype, sim_fused=sim_fused)
@@ -451,7 +458,7 @@ def main():
         "degradations": obs.ledger(),
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
-                   "sim_fused": sim_fused,
+                   "sim_fused": sim_fused, "exchange": exchange,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
                    "chunk": chunk, "scan_frames": bool(scan_frames),
                    "autotune_ms": autotune_ms,
@@ -484,12 +491,22 @@ def _probe_tpu() -> bool:
     return probe_tpu() > 0
 
 
-def _run_child(platform: str, timeout_s: int, extra_env=None):
+def _run_child(platform: str, timeout_s: int, extra_env=None,
+               attempt: int = 1):
     """Run the benchmark on one platform candidate in a subprocess; return
-    the parsed result dict or an error string."""
-    if platform == "tpu" and not _probe_tpu():
-        return None, "tpu: probe failed (tunnel dead or hung)"
-    print(f"[bench] trying platform={platform} (timeout {timeout_s}s"
+    the parsed result dict or an error string. ``attempt`` is the
+    1-based per-platform attempt index — it goes into the failure reason
+    so retries of the same platform stay DISTINCT entries in
+    ``failed_attempts`` instead of two identical lines (which read as a
+    copy-paste bug and dedupe to one ledger entry)."""
+    if platform == "tpu":
+        t0 = time.perf_counter()
+        if not _probe_tpu():
+            return None, (f"tpu attempt {attempt}: backend probe failed "
+                          f"after {time.perf_counter() - t0:.1f}s "
+                          f"(tunnel dead or hung)")
+    print(f"[bench] trying platform={platform} attempt {attempt} "
+          f"(timeout {timeout_s}s"
           + (f", {extra_env}" if extra_env else "") + ")",
           file=sys.stderr, flush=True)
     env = _child_env(platform)
@@ -502,18 +519,20 @@ def _run_child(platform: str, timeout_s: int, extra_env=None):
             stdout=subprocess.PIPE, stderr=None,
             timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return None, f"{platform}: timed out after {timeout_s}s"
+        return None, (f"{platform} attempt {attempt}: child timed out "
+                      f"after {timeout_s}s")
     out = proc.stdout.decode("utf-8", "replace")
     if proc.returncode != 0:
         tail = out.strip().splitlines()[-3:]
-        return None, f"{platform}: rc={proc.returncode} {' | '.join(tail)}"
+        return None, (f"{platform} attempt {attempt}: rc={proc.returncode} "
+                      f"{' | '.join(tail)}")
     for line in reversed(out.strip().splitlines()):
         if line.startswith("{"):
             try:
                 return json.loads(line), None
             except json.JSONDecodeError:
                 break
-    return None, f"{platform}: no JSON line in child output"
+    return None, f"{platform} attempt {attempt}: no JSON line in child output"
 
 
 def _latest_hw():
@@ -563,12 +582,24 @@ def _orchestrate():
     # dead tunnel costs one cheap probe per TPU attempt (not the full
     # child timeout) + the CPU fallback
     timeout_s = _env_int("SITPU_BENCH_CHILD_TIMEOUT", 900)
-    platforms = os.environ.get("SITPU_BENCH_PLATFORMS", "tpu,tpu,cpu")
+    platforms = [p.strip() for p in os.environ.get(
+        "SITPU_BENCH_PLATFORMS", "tpu,tpu,cpu").split(",")]
     errors = []
     tpu_children_failed = 0
-    for i, platform in enumerate(p.strip() for p in platforms.split(",")):
+    attempts = {}
+    from scenery_insitu_tpu import obs
+
+    for i, platform in enumerate(platforms):
+        attempts[platform] = attempts.get(platform, 0) + 1
         if i > 0:
-            time.sleep(min(10 * i, 30))   # backoff between attempts
+            # bounded exponential backoff between platform probes: a
+            # tunnel mid-flap gets a real chance to recover before the
+            # retry probe instead of two back-to-back identical failures
+            delay = min(5 * 2 ** (i - 1), 30)
+            print(f"[bench] backing off {delay}s before {platform} "
+                  f"attempt {attempts[platform]}", file=sys.stderr,
+                  flush=True)
+            time.sleep(delay)
         extra = {}
         if (platform == "tpu" and tpu_children_failed >= 1
                 and "SITPU_BENCH_FOLD" not in os.environ):
@@ -579,7 +610,8 @@ def _orchestrate():
             # Mosaic exposure — and still chunk-granular state traffic,
             # unlike the per-slice "xla" machine fold)
             extra["SITPU_BENCH_FOLD"] = "seg"
-        result, err = _run_child(platform, timeout_s, extra)
+        result, err = _run_child(platform, timeout_s, extra,
+                                 attempt=attempts[platform])
         if (platform == "tpu" and err is not None
                 and "probe failed" not in err):
             tpu_children_failed += 1
@@ -590,17 +622,15 @@ def _orchestrate():
                 # framework's speed; with this it reads as an outage),
                 # and the newest committed hardware truth for comparison
                 result["failed_attempts"] = errors
-                # same facts in fallback-ledger shape, merged with the
-                # child's own ledger: the run was CONFIGURED for the
-                # earlier platform entries and actually ran on this one
-                # (previously "tunnel dead or hung" lived only in the
-                # stdout tail of the artifact)
-                from scenery_insitu_tpu import obs
-
-                for e in errors:
-                    obs.degrade("bench.platform",
-                                e.split(":", 1)[0], platform, e,
-                                warn=False)
+                # the per-attempt failures were ledgered as they happened
+                # (distinct reasons, so retries don't dedupe away); this
+                # entry records the DOWNGRADE itself — only when the run
+                # landed on a DIFFERENT platform than configured (a retry
+                # of the same platform that succeeds is not a downgrade)
+                if platform != platforms[0]:
+                    obs.degrade("bench.platform", platforms[0], platform,
+                                f"downgraded after {len(errors)} failed "
+                                f"attempt(s): {errors[-1]}", warn=False)
                 result["degradations"] = (
                     result.get("degradations") or []) + obs.ledger()
                 hw = _latest_hw()
@@ -609,12 +639,15 @@ def _orchestrate():
             print(json.dumps(result), flush=True)
             return
         errors.append(err)
+        # ledger each failed attempt at failure time with its DISTINCT
+        # reason (attempt index + phase), so the final artifact's ledger
+        # separates "probe never answered" from "child ran and died"
+        obs.degrade("bench.platform_attempt",
+                    f"{platform} attempt {attempts[platform]}",
+                    "failed", err, warn=False)
         print(f"[bench] attempt failed: {err}", file=sys.stderr, flush=True)
-    from scenery_insitu_tpu import obs
-
-    for e in errors:
-        obs.degrade("bench.platform", e.split(":", 1)[0], "none", e,
-                    warn=False)
+    obs.degrade("bench.platform", platforms[0], "none",
+                f"all {len(errors)} attempts failed", warn=False)
     out = {
         "metric": f"gray_scott_{grid}c_vdi_fps",
         "grid_note": "default = 512 on tpu, 128 on cpu",

@@ -10,8 +10,12 @@ markers (:301,336,397-398). This harness does the same on the TPU path:
 
 - **ici mode** (default): per-rank sub-VDIs are placed rank-sharded on the
   device mesh and each iteration runs the one jitted SPMD step — width-axis
-  ``lax.all_to_all`` + fused sort-merge composite — exactly the production
-  pipeline's chain.
+  column exchange + fused sort-merge composite — exactly the production
+  pipeline's chain. ``--exchange both`` (the default) A/Bs the
+  ``all_to_all`` schedule against the ring-pipelined one
+  (CompositeConfig.exchange; docs/PERF.md "Exchange modes"), reporting
+  per-mode ms/iter, the modeled exchange + composite working-set bytes
+  (the N·K → ring_slots+K reduction) and output parity.
 - **compressed mode** (``--compressed``): the host hop — each rank's VDI is
   split into per-destination column segments, compressed (zstd by default),
   "exchanged", decompressed (timed as #DECOM) and composited (#COMP) — the
@@ -88,6 +92,13 @@ def main():
     ap.add_argument("--max-steps", type=int, default=96)
     ap.add_argument("--compressed", action="store_true",
                     help="host-hop per-segment compression variant")
+    ap.add_argument("--exchange", default="both",
+                    choices=("all_to_all", "ring", "both"),
+                    help="ici-mode exchange schedule(s) to run")
+    ap.add_argument("--ring-slots", type=int, default=0,
+                    help="ring accumulator cap (0 = lossless N*K)")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON summary to PATH (CI artifact)")
     ap.add_argument("--codec", default="zstd")
     ap.add_argument("--dir", default=None,
                     help="replay stored *_subvdi_*.npz fixtures from DIR")
@@ -152,53 +163,88 @@ def main():
 
     if not args.compressed:
         # --------------------------- ICI path: the production SPMD chain
-        from scenery_insitu_tpu.ops.composite import composite_vdis
+        import dataclasses
+
+        from scenery_insitu_tpu.ops.composite import modeled_exchange_traffic
         from scenery_insitu_tpu.parallel.mesh import make_mesh
-        from scenery_insitu_tpu.parallel.pipeline import _exchange_columns
+        from scenery_insitu_tpu.parallel.pipeline import _composite_exchanged
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = make_mesh(n)
         axis = mesh.axis_names[0]
+        modes = (["all_to_all", "ring"] if args.exchange == "both"
+                 else [args.exchange])
 
-        def step(color, depth):                 # [K,4,H,W] per rank
-            colors = _exchange_columns(color, n, axis)
-            depths = _exchange_columns(depth, n, axis)
-            out = composite_vdis(colors, depths, comp_cfg)
-            return out.color, out.depth
+        base_c = jnp.concatenate([v.color for v in vdis])
+        base_d = jnp.concatenate([v.depth for v in vdis])
 
-        f = jax.jit(shard_map(
-            step, mesh=mesh, in_specs=(P(axis), P(axis)),
-            out_specs=(P(None, None, None, axis), P(None, None, None, axis)),
-            check_vma=False))
+        per_mode = {}
+        first_out = {}
+        for mode in modes:
+            cfg_m = dataclasses.replace(comp_cfg, exchange=mode,
+                                        ring_slots=args.ring_slots)
 
-        stack_c = jax.device_put(
-            jnp.concatenate([v.color for v in vdis]),
-            NamedSharding(mesh, P(axis)))
-        stack_d = jax.device_put(
-            jnp.concatenate([v.depth for v in vdis]),
-            NamedSharding(mesh, P(axis)))
+            def step(color, depth, cfg_m=cfg_m):    # [K,4,H,W] per rank
+                out = _composite_exchanged(color, depth, n, axis, cfg_m)
+                return out.color, out.depth
 
-        oc, od = f(stack_c, stack_d)            # compile
-        jax.block_until_ready(oc)
-        total = 0.0
-        # chain an input perturbation so no layer can dedupe identical
-        # executions (see axon notes)
-        for it in range(args.iters):
-            t0 = time.perf_counter()
-            oc, od = f(stack_c, stack_d)
+            f = jax.jit(shard_map(
+                step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                out_specs=(P(None, None, None, axis),
+                           P(None, None, None, axis)),
+                check_vma=False))
+
+            stack_c = jax.device_put(base_c, NamedSharding(mesh, P(axis)))
+            stack_d = jax.device_put(base_d, NamedSharding(mesh, P(axis)))
+
+            oc, od = f(stack_c, stack_d)            # compile
             jax.block_until_ready(oc)
-            dt = time.perf_counter() - t0
-            total += dt
-            stack_c = stack_c.at[0, 0, 0, 0].add(float(oc[0, 0, 0, 0]) * 1e-6)
-            print(f"#COMP:all:{it}:{dt:.6f}#")
-            print(f"#IT:all:{it}:{dt:.6f}#")
+            first_out[mode] = (np.asarray(oc), np.asarray(od))
+            total = 0.0
+            # chain an input perturbation so no layer can dedupe identical
+            # executions (see axon notes)
+            for it in range(args.iters):
+                t0 = time.perf_counter()
+                oc, od = f(stack_c, stack_d)
+                jax.block_until_ready(oc)
+                dt = time.perf_counter() - t0
+                total += dt
+                stack_c = stack_c.at[0, 0, 0, 0].add(
+                    float(oc[0, 0, 0, 0]) * 1e-6)
+                print(f"#COMP:{mode}:{it}:{dt:.6f}#")
+                print(f"#IT:{mode}:{it}:{dt:.6f}#")
+            per_mode[mode] = {
+                "ms_per_iter": round(total / args.iters * 1000, 3),
+                # modeled per-rank exchange + composite working set — the
+                # N·K → ring_slots+K live-state lever the ring exists for
+                "modeled": modeled_exchange_traffic(
+                    n, k, h, w, k_out=args.k_out, mode=mode,
+                    ring_slots=args.ring_slots),
+            }
+
         summary = {
             "metric": f"composite_ici_{n}ranks_k{k}_{w}x{h}",
-            "value": round(total / args.iters * 1000, 3),
+            "value": per_mode[modes[0]]["ms_per_iter"],
             "unit": "ms/iter",
             "mode": "ici",
+            "exchange": per_mode,
+            "ring_slots": args.ring_slots,
             "backend": jax.default_backend(),
         }
+        if len(modes) == 2:
+            # parity of the two schedules on the SAME (unperturbed)
+            # inputs: lossless ring must match all_to_all exactly
+            ac, ad = first_out["all_to_all"]
+            rc, rd = first_out["ring"]
+            dc = float(np.abs(ac - rc).max())
+            fin = np.isfinite(ad) & np.isfinite(rd)
+            dd = float(np.abs(ad[fin] - rd[fin]).max()) if fin.any() else 0.0
+            summary["parity"] = {
+                "max_abs_diff_color": dc,
+                "max_abs_diff_depth_finite": dd,
+                "empty_slot_layout_match":
+                    bool((np.isinf(ad) == np.isinf(rd)).all()),
+            }
     else:
         # ------------------- compressed host hop (DCN / disk wire format)
         from scenery_insitu_tpu.ops.composite import composite_vdis
@@ -259,6 +305,9 @@ def main():
             "backend": jax.default_backend(),
         }
     print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(summary, fh, indent=2)
 
 
 if __name__ == "__main__":

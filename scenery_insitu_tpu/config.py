@@ -159,6 +159,33 @@ class CompositeConfig:
     # Merge-fold schedule: "xla" = lax.scan over slots; "pallas" = fused
     # pixel-tile kernel (ops.pallas_composite); "auto" = pallas on TPU.
     backend: str = "auto"
+    # Sort-last exchange schedule (docs/PERF.md "Exchange modes"):
+    #   "all_to_all"  one blocking lax.all_to_all of all column fragments,
+    #                 then an N·K-wide sort-merge per pixel (≅ the
+    #                 reference's distributeVDIs MPI all-to-all shape);
+    #   "ring"        n-1 lax.ppermute hops around the ICI ring, each
+    #                 incoming K-fragment merged into a per-rank sorted
+    #                 accumulator by the pairwise ordered merge
+    #                 (ops.composite.merge_vdis_pairwise) — no N·K bitonic
+    #                 sort, and XLA overlaps the next hop with the current
+    #                 merge. Single-rank meshes fall back to all_to_all
+    #                 (both are the identity there).
+    exchange: str = "all_to_all"
+    # Ring accumulator cap, in supersegment slots per pixel. 0 = lossless:
+    # the accumulator grows to N·K slots and ring output matches the
+    # all_to_all path exactly. > 0 bounds the live per-pixel working set
+    # to ring_slots + K slots (e.g. 2K at ring_slots=K) by dropping the
+    # FARTHEST segments of overfull pixels at every merge — bounded
+    # memory, approximate on pixels that overflow the cap.
+    ring_slots: int = 0
+
+    def __post_init__(self):
+        if self.exchange not in ("all_to_all", "ring"):
+            raise ValueError(f"exchange must be 'all_to_all' or 'ring', "
+                             f"got {self.exchange!r}")
+        if self.ring_slots < 0:
+            raise ValueError(f"ring_slots must be >= 0 (0 = lossless), "
+                             f"got {self.ring_slots}")
 
 
 @dataclass(frozen=True)

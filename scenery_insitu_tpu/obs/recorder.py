@@ -28,7 +28,7 @@ Three layers:
 - exporters: Chrome-trace/Perfetto JSON (open ``trace.json`` at
   ``ui.perfetto.dev`` — complements the device-side
   ``jax.profiler.trace`` dir) and a JSONL metrics stream; the rank is in
-  every event so multihost merges (parallel/multihost.merge_rank_events)
+  every event so multihost merges (parallel/multihost.gather_obs_events)
   are a concatenation.
 """
 

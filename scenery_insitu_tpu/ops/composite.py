@@ -7,6 +7,15 @@
   (one vectorized ``jnp.sort`` — XLA lowers to a bitonic network, no
   divergence), and fold the sorted stream through the shared supersegment
   state machine for re-segmentation.
+- ``merge_vdis_pairwise``: the ring-exchange counterpart (docs/PERF.md
+  "Exchange modes"): two per-pixel depth-SORTED segment streams interleave
+  by searchsorted-style rank selection — no bitonic sort, peak live state
+  is the two streams instead of all N·K slots. The ring compositor
+  (parallel.pipeline) folds one incoming K-fragment per ``ppermute`` hop
+  into its accumulator with this, then re-segments the final stream
+  through ``resegment_stream`` — the same backend dispatch + adaptive
+  threshold + fold ``composite_vdis`` runs after its global sort, which is
+  what makes lossless ring output exactly match the all_to_all path.
 - ``composite_plain``: depth-ordered alpha-under of N plain images
   (≅ PlainImageCompositor.comp:35-92).
 - ``composite_depth_min``: sort-first min-depth pick across ranks
@@ -80,6 +89,28 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
                               flat_d.dtype)]) if pad else flat_d
         return VDI(color, depth)
 
+    return resegment_stream(sc, sd, cfg, gap_eps)
+
+
+def resegment_stream(sc: jnp.ndarray, sd: jnp.ndarray,
+                     cfg: Optional[CompositeConfig] = None,
+                     gap_eps: float = 1e-4) -> VDI:
+    """Re-segment one per-pixel depth-SORTED segment stream into at most
+    ``cfg.max_output_supersegments`` output supersegments.
+
+    ``sc`` f32[M, 4, H, W] premultiplied colors, ``sd`` f32[M, 2, H, W]
+    depth extents, sorted by start depth per pixel with empty slots masked
+    (zero color, +inf depth). This is the post-sort half of
+    ``composite_vdis`` — backend dispatch, adaptive threshold search and
+    the supersegment fold — shared with the ring exchange path
+    (parallel.pipeline), whose pairwise-merged accumulator arrives here
+    already sorted. Identical streams produce identical output whichever
+    path built them.
+    """
+    cfg = cfg or CompositeConfig()
+    _, _, h, w = sc.shape
+    k_out = cfg.max_output_supersegments
+
     backend = cfg.backend
     if backend == "auto":
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
@@ -111,6 +142,101 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
     state, _ = jax.lax.scan(body, ss.init_state(k_out, h, w), (sc, sd))
     color, depth = ss.finalize(state)
     return VDI(color, depth)
+
+
+def merge_vdis_pairwise(color_a: jnp.ndarray, depth_a: jnp.ndarray,
+                        color_b: jnp.ndarray, depth_b: jnp.ndarray,
+                        k_cap: Optional[int] = None
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pairwise ordered merge of two per-pixel depth-SORTED segment
+    streams (the ring-exchange merge operator; docs/PERF.md "Exchange
+    modes").
+
+    ``color_a`` f32[Ka, 4, H, W] / ``depth_a`` f32[Ka, 2, H, W] and the
+    ``b`` pair likewise. PRECONDITION: each stream is sorted by start
+    depth per pixel (empty slots at +inf — the VDI convention; generation
+    output and any previous merge's output both satisfy it) with empty
+    slots' colors masked to zero. Unsorted inputs produce garbage — the
+    position arithmetic below is only a permutation for sorted inputs.
+
+    This is the sort-last depth-disjointness payoff: because the two
+    lists are already ordered, the merged position of every segment is
+    its own index plus how many of the OTHER list precede it — a
+    searchsorted-style rank selection of O(Ka·Kb) vectorized compares per
+    pixel, not an O(M log² M) bitonic network over the concatenation, and
+    the only live state is the two input streams (2K slots for two
+    K-lists vs the N·K slots the all_to_all sort materializes). Ties
+    break toward stream ``a`` (the accumulator), keeping the merge
+    deterministic. Payloads move by gather, so depth +inf survives
+    bit-exactly (no one-hot arithmetic against inf).
+
+    ``k_cap``: truncate the merged stream to its nearest ``k_cap``
+    segments (drop the farthest) — the bounded-memory ring mode
+    (CompositeConfig.ring_slots). None keeps all Ka+Kb slots.
+
+    Returns the merged (color [M, 4, H, W], depth [M, 2, H, W]),
+    M = min(Ka+Kb, k_cap or Ka+Kb), sorted with empties at the back.
+    """
+    ka, kb = color_a.shape[0], color_b.shape[0]
+    sa, sb = depth_a[:, 0], depth_b[:, 0]                  # [K?, H, W]
+    # merged position = own index + count of the other list before me;
+    # b_j precedes a_i iff sb_j < sa_i (ties -> a first)
+    b_before_a = jnp.sum((sb[None] < sa[:, None]).astype(jnp.int32), axis=1)
+    a_before_b = jnp.sum((sa[None] <= sb[:, None]).astype(jnp.int32), axis=1)
+    ia = jax.lax.broadcasted_iota(jnp.int32, (ka, 1, 1), 0)
+    ib = jax.lax.broadcasted_iota(jnp.int32, (kb, 1, 1), 0)
+    pos = jnp.concatenate([ia + b_before_a, ib + a_before_b], axis=0)
+    m = ka + kb
+    m_out = m if k_cap is None else min(int(k_cap), m)
+    # invert the permutation by an O(M) scatter (pos is a permutation of
+    # 0..M-1 per pixel for sorted inputs, so every update is in bounds),
+    # then GATHER payloads — depth +inf must survive bit-exactly, so no
+    # arithmetic ever touches the payload values. Truncation = dropping
+    # the output slots past m_out (the farthest segments).
+    in_ids = jnp.broadcast_to(
+        jax.lax.broadcasted_iota(jnp.int32, (m, 1, 1), 0), pos.shape)
+    inv = jnp.put_along_axis(jnp.zeros_like(pos), pos, in_ids, axis=0,
+                             inplace=False)[:m_out]        # [M_out, H, W]
+    all_c = jnp.concatenate([color_a, color_b], axis=0)
+    all_d = jnp.concatenate([depth_a, depth_b], axis=0)
+    color = jnp.take_along_axis(all_c, inv[:, None], axis=0)
+    depth = jnp.take_along_axis(all_d, inv[:, None], axis=0)
+    return color, depth
+
+
+def modeled_exchange_traffic(n: int, k: int, height: int, width: int,
+                             k_out: Optional[int] = None,
+                             mode: str = "all_to_all", ring_slots: int = 0,
+                             itemsize: int = 4) -> dict:
+    """Modeled per-rank bytes of the sort-last exchange + composite for
+    one frame — the composite counterpart of
+    ``sim.pallas_stencil.modeled_sim_traffic`` (probe-free, usable
+    off-TPU), consumed by ``benchmarks/composite_bench.py`` and the ring
+    build's obs event.
+
+    ``ici_bytes_per_rank`` is the wire traffic each rank ships (n-1
+    K-fragments of its W/n column block — identical in both modes; the
+    ring only changes WHEN it moves and what must be live meanwhile).
+    ``peak_stream_slots_per_pixel`` is the per-pixel working set of the
+    merge: the all_to_all path materializes and sorts all N·K received
+    slots; the capped ring holds ring_slots + K (accumulator + incoming
+    fragment, e.g. 2K at ring_slots=K); the lossless ring (ring_slots=0)
+    grows back to N·K by the last hop.
+    """
+    wb = max(width // max(n, 1), 1)
+    seg = 6 * itemsize                        # 4 color + 2 depth per slot
+    frag = k * height * wb * seg
+    if mode == "ring" and ring_slots:
+        slots = min(int(ring_slots), n * k) + k
+    else:
+        slots = n * k
+    return {
+        "mode": mode, "ranks": n, "k": k,
+        "k_out": k_out, "ring_slots": ring_slots,
+        "ici_bytes_per_rank": (n - 1) * frag,
+        "peak_stream_slots_per_pixel": slots,
+        "stream_bytes_per_rank": slots * height * wb * seg,
+    }
 
 
 def composite_plain(images: jnp.ndarray, depths: jnp.ndarray,

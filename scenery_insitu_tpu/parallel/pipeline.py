@@ -16,6 +16,17 @@ No postRenderLambda/AtomicInteger interlock machinery survives
 (DistributedVolumes.kt:736-796): XLA schedules generation, collective and
 composite as one program and overlaps compute with ICI transfers.
 
+Two exchange schedules (``CompositeConfig.exchange``; docs/PERF.md
+"Exchange modes"): the default monolithic ``all_to_all`` + N·K-wide
+sort-merge above, and a **ring** schedule — each rank keeps its own
+column block and the others' fragments circulate over ICI in n-1
+``lax.ppermute`` hops, each incoming K-fragment folded into a per-rank
+sorted accumulator by the pairwise ordered merge
+(ops.composite.merge_vdis_pairwise). The ring needs no N·K bitonic sort,
+XLA's async collectives fly the next hop while the current fragment
+merges, and with ``ring_slots`` set the per-pixel live state is bounded
+at ring_slots + K instead of N·K.
+
 Decomposition is 1-D over the volume z axis with one-voxel halo exchange,
 making distributed trilinear sampling seam-exact vs a single-device render
 (tests assert PSNR, test_parallel.py).
@@ -72,6 +83,154 @@ def _exchange_columns(x: jnp.ndarray, n: int, axis_name: str) -> jnp.ndarray:
                               tiled=True)
 
 
+def _column_blocks(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Split the trailing W axis into n blocks → [n, ..., W/n]; block j is
+    the columns rank j composites (the pre-collective half of
+    `_exchange_columns`, reused by the ring schedule which ships blocks
+    one hop at a time instead of all at once)."""
+    w = x.shape[-1]
+    return jnp.moveaxis(x.reshape(x.shape[:-1] + (n, w // n)), -2, 0)
+
+
+def _take_block(blocks: jnp.ndarray, j) -> jnp.ndarray:
+    """blocks[j] for a traced rank index j."""
+    return jax.lax.dynamic_index_in_dim(blocks, j, axis=0, keepdims=False)
+
+
+def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
+                             n: int, axis_name: str, cfg,
+                             gap_eps: float = 1e-4):
+    """Ring-pipelined sort-last compositing (CompositeConfig.exchange ==
+    "ring"): this rank keeps its own column block; at hop s = 1..n-1 every
+    rank ppermutes ONE K-fragment (its block for rank r-s) so rank r
+    receives rank (r+s)'s fragment of ITS columns, and merges it into a
+    per-pixel sorted accumulator with the pairwise ordered merge — XLA's
+    async collectives let hop s+1 fly while fragment s merges, hiding ICI
+    latency behind merge compute. The final accumulator is re-segmented by
+    the SAME fold the all_to_all path runs after its global sort
+    (ops.composite.resegment_stream), so lossless ring (ring_slots=0)
+    output matches the all_to_all composite exactly; ring_slots > 0 caps
+    the accumulator (bounded memory, farthest segments dropped on overfull
+    pixels).
+
+    Tie order among exactly-equal start depths follows arrival order
+    (r, r+1, ... wrapping) instead of the all_to_all path's rank order —
+    only observable for bit-identical live start depths, since empty
+    slots' payloads are masked identically in both paths.
+    """
+    from scenery_insitu_tpu import obs as _obs
+    from scenery_insitu_tpu.ops.composite import (merge_vdis_pairwise,
+                                                  modeled_exchange_traffic,
+                                                  resegment_stream)
+
+    k = color.shape[0]
+    h, w = color.shape[-2], color.shape[-1]
+    cap = int(cfg.ring_slots) or None
+    if cap is not None and cap < k:
+        raise ValueError(
+            f"ring_slots={cap} is below the per-rank fragment size K={k} "
+            f"— the accumulator could not even hold one incoming fragment "
+            f"(use 0 for lossless, or >= K, e.g. 2*K)")
+
+    # host-side build markers (this runs at trace time, once per compiled
+    # step): the per-hop events give the trace one entry per ring step
+    # with the modeled fragment bytes the hop moves
+    rec = _obs.get_recorder()
+    rec.count("ring_exchange_builds")
+    rec.event("ring_exchange_build", ranks=n, k=k,
+              slots=(cap or n * k),
+              traffic=modeled_exchange_traffic(
+                  n, k, h, w, k_out=cfg.max_output_supersegments,
+                  mode="ring", ring_slots=cfg.ring_slots))
+
+    # one K-wide per-pixel sort + stale-color mask of the LOCAL fragment
+    # replaces the all_to_all path's N·K-wide post-exchange sort (the VDI
+    # convention already promises front-to-back live slots; the sort makes
+    # the merge's sorted-input precondition unconditional)
+    order = jnp.argsort(depth[:, 0], axis=0)
+    color = jnp.take_along_axis(color, order[:, None], axis=0)
+    depth = jnp.take_along_axis(depth, order[:, None], axis=0)
+    color = jnp.where(jnp.isfinite(depth[:, 0])[:, None], color, 0.0)
+
+    blk_c = _column_blocks(color, n)                  # [n, K, 4, H, W/n]
+    blk_d = _column_blocks(depth, n)
+    r = jax.lax.axis_index(axis_name)
+    acc_c, acc_d = _take_block(blk_c, r), _take_block(blk_d, r)
+    frag_bytes = (blk_c.size + blk_d.size) // n * color.dtype.itemsize
+    for s in range(1, n):
+        # rank i ships its block for rank i-s; receiver r hears from r+s
+        perm = [(i, (i - s) % n) for i in range(n)]
+        send_c = _take_block(blk_c, jnp.mod(r - s, n))
+        send_d = _take_block(blk_d, jnp.mod(r - s, n))
+        recv_c = jax.lax.ppermute(send_c, axis_name, perm)
+        recv_d = jax.lax.ppermute(send_d, axis_name, perm)
+        rec.count("ring_steps_built")
+        rec.event("ring_step", step=s, hops=s, frag_bytes=frag_bytes)
+        acc_c, acc_d = merge_vdis_pairwise(acc_c, acc_d, recv_c, recv_d,
+                                           k_cap=cap)
+    return resegment_stream(acc_c, acc_d, cfg, gap_eps)
+
+
+def _composite_exchanged(color: jnp.ndarray, depth: jnp.ndarray,
+                         n: int, axis_name: str, comp_cfg):
+    """Sort-last exchange + composite under the configured schedule
+    (CompositeConfig.exchange). Runs inside shard_map; returns the
+    composited VDI of this rank's column block. n == 1 always takes the
+    all_to_all path (both schedules are the identity exchange there, and
+    it keeps the single-VDI fast path of `composite_vdis`)."""
+    if comp_cfg.exchange == "ring" and n > 1:
+        return _ring_exchange_composite(color, depth, n, axis_name,
+                                        comp_cfg)
+    colors = _exchange_columns(color, n, axis_name)   # [n, K, 4, H, W/n]
+    depths = _exchange_columns(depth, n, axis_name)
+    return composite_vdis(colors, depths, comp_cfg)
+
+
+def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
+                         n: int, axis_name: str):
+    """Ring schedule for the plain-image exchange: n-1 single-fragment
+    ppermute hops (pipelined like the VDI ring), then the stacked
+    fragments are rolled back into SOURCE-RANK order so the downstream
+    `composite_plain` sees the exact [n, ...] layout the all_to_all
+    delivers — bitwise-identical output. Plain fragments are one
+    RGBA+depth per pixel, so there is no N·K working set to cap; the win
+    is purely the pipelined exchange. Returns (images [n, 4, H, W/n],
+    depths [n, H, W/n])."""
+    from scenery_insitu_tpu import obs as _obs
+
+    blk_i = _column_blocks(image, n)                  # [n, 4, H, W/n]
+    blk_d = _column_blocks(depth, n)                  # [n, H, W/n]
+    r = jax.lax.axis_index(axis_name)
+    rec = _obs.get_recorder()
+    rec.count("ring_exchange_builds")
+    frags_i = [_take_block(blk_i, r)]
+    frags_d = [_take_block(blk_d, r)]
+    for s in range(1, n):
+        perm = [(i, (i - s) % n) for i in range(n)]
+        frags_i.append(jax.lax.ppermute(
+            _take_block(blk_i, jnp.mod(r - s, n)), axis_name, perm))
+        frags_d.append(jax.lax.ppermute(
+            _take_block(blk_d, jnp.mod(r - s, n)), axis_name, perm))
+        rec.count("ring_steps_built")
+    stacked_i = jnp.stack(frags_i)          # arrival order: r, r+1, ...
+    stacked_d = jnp.stack(frags_d)
+    # out[i] = stacked[(i - r) % n] = source rank i
+    return jnp.roll(stacked_i, r, axis=0), jnp.roll(stacked_d, r, axis=0)
+
+
+def _composite_plain_exchanged(image: jnp.ndarray, depth: jnp.ndarray,
+                               n: int, axis_name: str, background,
+                               exchange: str):
+    """Plain-image exchange + nearest-first composite under the configured
+    schedule (`exchange` ∈ {"all_to_all", "ring"})."""
+    if exchange == "ring" and n > 1:
+        images, depths = _ring_exchange_plain(image, depth, n, axis_name)
+    else:
+        images = _exchange_columns(image, n, axis_name)  # [n, 4, H, W/n]
+        depths = _exchange_columns(depth, n, axis_name)  # [n, H, W/n]
+    return composite_plain(images, depths, background)
+
+
 def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
                          width: int, height: int,
                          vdi_cfg: Optional[VDIConfig] = None,
@@ -98,9 +257,7 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
         vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
                               max_steps=max_steps, clip_min=cmin,
                               clip_max=cmax)
-        colors = _exchange_columns(vdi.color, n, axis)     # [n, K, 4, H, W/n]
-        depths = _exchange_columns(vdi.depth, n, axis)
-        return composite_vdis(colors, depths, comp_cfg)
+        return _composite_exchanged(vdi.color, vdi.depth, n, axis, comp_cfg)
 
     spec_vol = P(axis, None, None)
     spec_out = VDI(P(None, None, None, axis), P(None, None, None, axis))
@@ -206,8 +363,9 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
                              axis_name: Optional[str] = None):
     """Distributed sort-last VDI pipeline on the MXU slice-march engine
     (ops/slicer.py) — generation runs as banded-matmul slice resampling
-    instead of per-ray gathers; the rest of the chain (width-axis
-    ``all_to_all``, sort-merge composite) is unchanged.
+    instead of per-ray gathers; the rest of the chain (width-axis column
+    exchange under ``comp_cfg.exchange`` — all_to_all or ring — then the
+    sort-merge composite) is unchanged.
 
     ``spec`` is the static `slicer.AxisSpec` for the *current camera
     regime* (march axis/sign + intermediate resolution); the session keeps
@@ -225,8 +383,8 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
 def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
                     temporal: bool):
     """Shared builder of the MXU sort-last step (generate → column
-    all_to_all → composite), with or without carried temporal threshold
-    state threaded through."""
+    exchange under ``comp_cfg.exchange`` → composite), with or without
+    carried temporal threshold state threaded through."""
     from scenery_insitu_tpu.core.vdi import VDIMetadata
     from scenery_insitu_tpu.ops import slicer
 
@@ -243,9 +401,8 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
                                                 spacing, cam, slicer, spec,
                                                 tf, vdi_cfg, axis, n,
                                                 threshold=thr)
-        colors = _exchange_columns(vdi.color, n, axis)     # [n,K,4,Nj,Ni/n]
-        depths = _exchange_columns(vdi.depth, n, axis)
-        return composite_vdis(colors, depths, comp_cfg), meta, thr2
+        return (_composite_exchanged(vdi.color, vdi.depth, n, axis,
+                                     comp_cfg), meta, thr2)
 
     spec_vol = P(axis, None, None)
     out_vdi = VDI(P(None, None, None, axis), P(None, None, None, axis))
@@ -374,9 +531,8 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
         vdi, meta, axcam, thr2 = _mxu_rank_generate(
             local_data, origin, spacing, cam, slicer, spec, tf, vdi_cfg,
             axis, n, threshold=thr)
-        colors = _exchange_columns(vdi.color, n, axis)
-        depths = _exchange_columns(vdi.depth, n, axis)
-        comp = composite_vdis(colors, depths, comp_cfg)    # [Ko,·,Nj,Ni/n]
+        comp = _composite_exchanged(vdi.color, vdi.depth, n, axis,
+                                    comp_cfg)              # [Ko,·,Nj,Ni/n]
 
         # sort-first particle pass on the virtual camera's rays
         sp = sort_first_splat(tr_pos, tr_vel, axis, spec.ni, spec.nj,
@@ -422,7 +578,8 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
 
 def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                spec, cfg: Optional[RenderConfig] = None,
-                               axis_name: Optional[str] = None):
+                               axis_name: Optional[str] = None,
+                               exchange: str = "all_to_all"):
     """Distributed plain-image rendering on the MXU slice-march engine —
     the TPU-fast counterpart of `distributed_plain_step` (the reference's
     non-VDI mode, VolumeRaycaster.comp:94-161 composited by
@@ -438,6 +595,12 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
     ``slicer.warp_to_camera(image, axcam, spec, cam, width, height,
     background)``. ``axcam`` is replicated (every rank derives it from the
     shared global box), so the warp runs on the gathered global image.
+
+    ``exchange``: "all_to_all" (one collective) or "ring" (n-1 pipelined
+    single-fragment ppermute hops; bitwise-identical output — see
+    `_ring_exchange_plain`). Plain steps take the knob directly because
+    they carry no CompositeConfig; the session forwards
+    ``cfg.composite.exchange``.
     """
     from scenery_insitu_tpu.ops import slicer
 
@@ -473,10 +636,10 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                    spec, cfg.early_exit_alpha,
                                    v_bounds=v_bounds,
                                    step_scale=cfg.step_scale)
-        images = _exchange_columns(out.image, n, axis)     # [n, 4, Nj, Ni/n]
-        depths = _exchange_columns(out.depth, n, axis)     # [n, Nj, Ni/n]
         # rank partials stay background-free; the display warp blends it
-        return composite_plain(images, depths, (0.0, 0.0, 0.0, 0.0)), axcam
+        return _composite_plain_exchanged(out.image, out.depth, n, axis,
+                                          (0.0, 0.0, 0.0, 0.0),
+                                          exchange), axcam
 
     from scenery_insitu_tpu.ops.slicer import AxisCamera
     out_axcam = AxisCamera(*(P() for _ in AxisCamera._fields))
@@ -490,11 +653,14 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
 def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            width: int, height: int,
                            cfg: Optional[RenderConfig] = None,
-                           axis_name: Optional[str] = None):
+                           axis_name: Optional[str] = None,
+                           exchange: str = "all_to_all"):
     """Build the jitted distributed plain-image render step (the reference's
     non-VDI mode: VolumeRaycaster + PlainImageCompositor,
     DistributedVolumeRenderer.kt:175-189). Returns ``f(vol_data, origin,
-    spacing, cam) -> image f32[4, height, width]`` sharded by W."""
+    spacing, cam) -> image f32[4, height, width]`` sharded by W.
+    ``exchange`` selects the column-exchange schedule ("all_to_all" |
+    "ring" — see `distributed_plain_step_mxu`)."""
     cfg = cfg or RenderConfig(width=width, height=height)
     axis = axis_name or mesh.axis_names[0]
     n = mesh.shape[axis]
@@ -531,9 +697,8 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
             ao_vol = Volume(occ[hr - 1:hr + dn + 1], vol.origin, spacing)
         out = raycast(vol, tf, cam, width, height, rank_cfg,
                       clip_min=cmin, clip_max=cmax, ao_field=ao_vol)
-        images = _exchange_columns(out.image, n, axis)     # [n, 4, H, W/n]
-        depths = _exchange_columns(out.depth, n, axis)     # [n, H, W/n]
-        return composite_plain(images, depths, cfg.background)
+        return _composite_plain_exchanged(out.image, out.depth, n, axis,
+                                          cfg.background, exchange)
 
     f = shard_map(step, mesh=mesh,
                   in_specs=(P(axis, None, None), P(), P(), P()),

@@ -340,7 +340,8 @@ class InSituSession:
         else:
             self.mode = "plain"
             self._step = distributed_plain_step(
-                self.mesh, self.tf, r.width, r.height, r)
+                self.mesh, self.tf, r.width, r.height, r,
+                exchange=self.cfg.composite.exchange)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
@@ -812,8 +813,9 @@ class InSituSession:
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
-            step = distributed_plain_step_mxu(self.mesh, self.tf, spec,
-                                              self.cfg.render)
+            step = distributed_plain_step_mxu(
+                self.mesh, self.tf, spec, self.cfg.render,
+                exchange=self.cfg.composite.exchange)
             r = self.cfg.render
             slicer = self._slicer
 

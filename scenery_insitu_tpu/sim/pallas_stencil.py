@@ -171,9 +171,19 @@ def step_pallas(u: jnp.ndarray, v: jnp.ndarray, params_vec: jnp.ndarray,
                 t_steps: int = 1, interpret: bool = False, tz: int = 0):
     """Advance ``t_steps`` Gray-Scott steps in one fused kernel pass.
     ``params_vec = [f, k, du, dv, dt]`` (f32[5]). Requires
-    ``pick_tz(u.shape, t_steps) > 0``. ``tz=0`` auto-picks the largest
-    nominally-fitting slab that passes the Mosaic probe (on TPU); an
-    explicit tz must satisfy ``t_steps | tz | D``."""
+    ``pick_tz(u.shape, t_steps) > 0``.
+
+    Auto-pick contract (ADVICE r5 #4): ``tz=0`` walks the
+    budget-screened `tz_candidates` and, on TPU, takes the first one the
+    MOSAIC COMPILE PROBE accepts — the 96 MB ``_VMEM_BUDGET`` screen is a
+    heuristic and must never be the last word, or a direct call would
+    compile-crash inside a traced step where nothing can catch it. If no
+    candidate compiles this raises ``ValueError`` at trace time (use
+    `multi_step_pallas`, which degrades to smaller T / the XLA roll
+    path, for a never-raises schedule). An EXPLICIT ``tz`` is taken on
+    trust after the ``t_steps | tz | D`` shape check: it is NOT probed,
+    so Mosaic resource errors surface to the caller at compile time —
+    pass probe-validated values (`_best_schedule`) when that matters."""
     d, h, w = u.shape
     t = t_steps
     if tz:
@@ -332,10 +342,17 @@ def tile2d_candidates(shape, t_steps: int = 1) -> tuple:
                    static_argnames=("t_steps", "interpret", "tz", "th"))
 def step_pallas2d(u, v, params_vec, t_steps: int = 1,
                   interpret: bool = False, tz: int = 0, th: int = 0):
-    """Advance ``t_steps`` steps in one 2D-blocked fused pass. An
-    explicit (tz, th) must satisfy ``T | tz | D`` and ``T | th | H``
-    (the `tile2d_candidates` constraints); (0, 0) auto-picks the
-    lowest-traffic tile that passes the Mosaic probe (on TPU)."""
+    """Advance ``t_steps`` steps in one 2D-blocked fused pass.
+
+    Same auto-pick contract as `step_pallas` (ADVICE r5 #4): ``(0, 0)``
+    walks `tile2d_candidates` best-first and, on TPU, returns the first
+    tile the Mosaic compile probe accepts — the VMEM budget is only a
+    screen — raising ``ValueError`` at trace time when none compiles
+    (`multi_step_pallas` is the degrading wrapper). An explicit
+    ``(tz, th)`` must satisfy ``T | tz | D`` and ``T | th | H`` (the
+    `tile2d_candidates` lattice) and is then taken on trust — unprobed,
+    so Mosaic errors surface at compile time; route through
+    `_best_schedule` for probe-validated tiles."""
     d, h, w = u.shape
     t = t_steps
     if tz or th:

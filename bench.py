@@ -144,6 +144,18 @@ def _model_frame_bytes(grid: int, sim_steps: int, marches: int,
     return sim + render_copy + marches * vox * render_bytes
 
 
+def _mod_exchange(n: int, k: int, height: int, width: int,
+                  exchange: str, wire: str) -> dict:
+    """Modeled per-rank sort-last exchange bytes for the configured
+    wire/schedule at an n-rank shape (ops.composite.modeled_exchange_traffic
+    — probe-free, so the single-chip bench can still report the lever)."""
+    from scenery_insitu_tpu.ops.composite import modeled_exchange_traffic
+
+    return modeled_exchange_traffic(
+        n, k, height, width, k_out=k,
+        mode=("ring" if exchange == "ring" else "all_to_all"), wire=wire)
+
+
 def _slice_march_flops(spec, grid: int, marches: int) -> float:
     """Matmul FLOPs of one frame of the MXU engine: ``marches`` full
     marches (counting + write) × grid slices × the two banded resampling
@@ -216,6 +228,11 @@ def main():
     # A/B in benchmarks/composite_bench.py (which measures the virtual
     # mesh) and to carry the choice into the artifact's config block
     exchange = os.environ.get("SITPU_BENCH_EXCHANGE", "all_to_all")
+    # supersegment wire format A/B (docs/PERF.md "Wire formats"): same
+    # single-chip story as the exchange knob — the distributed byte
+    # shrink is composite_bench's to measure; here the knob carries the
+    # config and the modeled per-wire exchange bytes into the artifact
+    wire = os.environ.get("SITPU_BENCH_WIRE", "f32")
 
     from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
@@ -239,7 +256,7 @@ def main():
                               adaptive_mode=ad_mode),
             comp_cfg=CompositeConfig(max_output_supersegments=k,
                                      adaptive_iters=ad_iters,
-                                     exchange=exchange),
+                                     exchange=exchange, wire=wire),
             engine=engine, grid_shape=(grid, grid, grid),
             axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
             slicer_cfg=mc, render_dtype=render_dtype, sim_fused=sim_fused)
@@ -455,10 +472,16 @@ def main():
         "cost_analysis": {
             (f"regime={slicer.choose_axis(base)}" if engine == "mxu"
              else "gather"): cost_snap},
+        # what the configured wire WOULD ship per rank at the reference
+        # 8-rank distributed shape of this config (modeled — single-chip
+        # runs have no exchange; composite_bench measures the real one)
+        "modeled_exchange_8rank": _mod_exchange(
+            8, k, height, width, exchange, wire),
         "degradations": obs.ledger(),
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "sim_fused": sim_fused, "exchange": exchange,
+                   "wire": wire,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
                    "chunk": chunk, "scan_frames": bool(scan_frames),
                    "autotune_ms": autotune_ms,

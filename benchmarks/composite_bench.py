@@ -15,7 +15,11 @@ markers (:301,336,397-398). This harness does the same on the TPU path:
   ``all_to_all`` schedule against the ring-pipelined one
   (CompositeConfig.exchange; docs/PERF.md "Exchange modes"), reporting
   per-mode ms/iter, the modeled exchange + composite working-set bytes
-  (the N·K → ring_slots+K reduction) and output parity.
+  (the N·K → ring_slots+K reduction) and output parity. ``--wire all``
+  additionally A/Bs the supersegment wire formats (CompositeConfig.wire;
+  docs/PERF.md "Wire formats"): each lossy mode reports ms/iter, the
+  modeled per-wire exchange bytes, the XLA-cost-analysis bytes of the
+  compiled step, and a PSNR block against the same-schedule f32 output.
 - **compressed mode** (``--compressed``): the host hop — each rank's VDI is
   split into per-destination column segments, compressed (zstd by default),
   "exchanged", decompressed (timed as #DECOM) and composited (#COMP) — the
@@ -97,6 +101,11 @@ def main():
                     help="ici-mode exchange schedule(s) to run")
     ap.add_argument("--ring-slots", type=int, default=0,
                     help="ring accumulator cap (0 = lossless N*K)")
+    ap.add_argument("--wire", default="f32",
+                    choices=("f32", "bf16", "qpack8", "all"),
+                    help="ici-mode supersegment wire format(s) to run "
+                         "(lossy modes always run f32 too, as the PSNR "
+                         "reference)")
     ap.add_argument("--out", default=None,
                     help="also write the JSON summary to PATH (CI artifact)")
     ap.add_argument("--codec", default="zstd")
@@ -174,6 +183,10 @@ def main():
         axis = mesh.axis_names[0]
         modes = (["all_to_all", "ring"] if args.exchange == "both"
                  else [args.exchange])
+        wires = (["f32", "bf16", "qpack8"] if args.wire == "all"
+                 else [args.wire])
+        if "f32" not in wires:          # the lossy modes' PSNR reference
+            wires = ["f32"] + wires
 
         base_c = jnp.concatenate([v.color for v in vdis])
         base_d = jnp.concatenate([v.depth for v in vdis])
@@ -181,46 +194,60 @@ def main():
         per_mode = {}
         first_out = {}
         for mode in modes:
-            cfg_m = dataclasses.replace(comp_cfg, exchange=mode,
-                                        ring_slots=args.ring_slots)
+            for wire in wires:
+                # f32 entries keep the bare exchange-mode key (the PR-4
+                # artifact shape); lossy wires nest under "mode/wire"
+                key = mode if wire == "f32" else f"{mode}/{wire}"
+                cfg_m = dataclasses.replace(comp_cfg, exchange=mode,
+                                            ring_slots=args.ring_slots,
+                                            wire=wire)
 
-            def step(color, depth, cfg_m=cfg_m):    # [K,4,H,W] per rank
-                out = _composite_exchanged(color, depth, n, axis, cfg_m)
-                return out.color, out.depth
+                def step(color, depth, cfg_m=cfg_m):  # [K,4,H,W] per rank
+                    out = _composite_exchanged(color, depth, n, axis, cfg_m)
+                    return out.color, out.depth
 
-            f = jax.jit(shard_map(
-                step, mesh=mesh, in_specs=(P(axis), P(axis)),
-                out_specs=(P(None, None, None, axis),
-                           P(None, None, None, axis)),
-                check_vma=False))
+                f = jax.jit(shard_map(
+                    step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                    out_specs=(P(None, None, None, axis),
+                               P(None, None, None, axis)),
+                    check_vma=False))
 
-            stack_c = jax.device_put(base_c, NamedSharding(mesh, P(axis)))
-            stack_d = jax.device_put(base_d, NamedSharding(mesh, P(axis)))
+                stack_c = jax.device_put(base_c,
+                                         NamedSharding(mesh, P(axis)))
+                stack_d = jax.device_put(base_d,
+                                         NamedSharding(mesh, P(axis)))
 
-            oc, od = f(stack_c, stack_d)            # compile
-            jax.block_until_ready(oc)
-            first_out[mode] = (np.asarray(oc), np.asarray(od))
-            total = 0.0
-            # chain an input perturbation so no layer can dedupe identical
-            # executions (see axon notes)
-            for it in range(args.iters):
-                t0 = time.perf_counter()
-                oc, od = f(stack_c, stack_d)
+                oc, od = f(stack_c, stack_d)            # compile
                 jax.block_until_ready(oc)
-                dt = time.perf_counter() - t0
-                total += dt
-                stack_c = stack_c.at[0, 0, 0, 0].add(
-                    float(oc[0, 0, 0, 0]) * 1e-6)
-                print(f"#COMP:{mode}:{it}:{dt:.6f}#")
-                print(f"#IT:{mode}:{it}:{dt:.6f}#")
-            per_mode[mode] = {
-                "ms_per_iter": round(total / args.iters * 1000, 3),
-                # modeled per-rank exchange + composite working set — the
-                # N·K → ring_slots+K live-state lever the ring exists for
-                "modeled": modeled_exchange_traffic(
-                    n, k, h, w, k_out=args.k_out, mode=mode,
-                    ring_slots=args.ring_slots),
-            }
+                first_out[key] = (np.asarray(oc), np.asarray(od))
+                # measured whole-step bytes from XLA's own cost analysis —
+                # the wire shrink shows up as the bytes_accessed delta
+                # between wire modes of the same schedule
+                from scenery_insitu_tpu.obs.device import cost_snapshot
+                snap = cost_snapshot(f, stack_c, stack_d)
+                total = 0.0
+                # chain an input perturbation so no layer can dedupe
+                # identical executions (see axon notes)
+                for it in range(args.iters):
+                    t0 = time.perf_counter()
+                    oc, od = f(stack_c, stack_d)
+                    jax.block_until_ready(oc)
+                    dt = time.perf_counter() - t0
+                    total += dt
+                    stack_c = stack_c.at[0, 0, 0, 0].add(
+                        float(oc[0, 0, 0, 0]) * 1e-6)
+                    print(f"#COMP:{key}:{it}:{dt:.6f}#")
+                    print(f"#IT:{key}:{it}:{dt:.6f}#")
+                per_mode[key] = {
+                    "ms_per_iter": round(total / args.iters * 1000, 3),
+                    # modeled per-rank exchange + composite working set —
+                    # the N·K → ring_slots+K live-state lever and the
+                    # per-wire ici byte shrink (docs/PERF.md)
+                    "modeled": modeled_exchange_traffic(
+                        n, k, h, w, k_out=args.k_out, mode=mode,
+                        ring_slots=args.ring_slots, wire=wire),
+                    "cost_analysis": snap,
+                }
 
         summary = {
             "metric": f"composite_ici_{n}ranks_k{k}_{w}x{h}",
@@ -229,8 +256,29 @@ def main():
             "mode": "ici",
             "exchange": per_mode,
             "ring_slots": args.ring_slots,
+            "wire": args.wire,
             "backend": jax.default_backend(),
         }
+        if len(wires) > 1:
+            # PSNR of each lossy wire's same-view render against the
+            # SAME schedule's f32 output — the quality side of the 4×
+            from scenery_insitu_tpu.core.vdi import (VDI as _VDI,
+                                                     render_vdi_same_view)
+            from scenery_insitu_tpu.utils.image import psnr
+
+            _rendered = {}
+
+            def rend(key):
+                if key not in _rendered:
+                    oc, od = first_out[key]
+                    _rendered[key] = np.asarray(render_vdi_same_view(
+                        _VDI(jnp.asarray(oc), jnp.asarray(od))))
+                return _rendered[key]
+
+            summary["wire_psnr_db"] = {
+                f"{mode}/{wire}": round(psnr(rend(f"{mode}/{wire}"),
+                                             rend(mode)), 2)
+                for mode in modes for wire in wires if wire != "f32"}
         if len(modes) == 2:
             # parity of the two schedules on the SAME (unperturbed)
             # inputs: lossless ring must match all_to_all exactly

@@ -178,6 +178,19 @@ class CompositeConfig:
     # FARTHEST segments of overfull pixels at every merge — bounded
     # memory, approximate on pixels that overflow the cap.
     ring_slots: int = 0
+    # Supersegment wire format of the sort-last exchange (docs/PERF.md
+    # "Wire formats"; ops/wire.py):
+    #   "f32"     6 f32 lanes, 24 B/slot — bit-exact, the pre-wire path;
+    #   "bf16"    color+depth cast to bfloat16, 12 B/slot (2×), lossy;
+    #   "qpack8"  RGBA → u8 unorm in a u32 lane + the depth pair → u8
+    #             each (per-fragment [near, far] normalization, sentinel
+    #             0xFFFF round-trips +inf empty slots exactly) in a u16
+    #             lane, 6 B/slot (4×), lossy.
+    # Encode runs before the collective and decode after it in BOTH
+    # exchange schedules, so ICI bytes shrink either way; the composite
+    # itself always runs in f32. Quantized modes are lossy by contract
+    # (tests hold them to PSNR floors).
+    wire: str = "f32"
 
     def __post_init__(self):
         if self.exchange not in ("all_to_all", "ring"):
@@ -186,6 +199,9 @@ class CompositeConfig:
         if self.ring_slots < 0:
             raise ValueError(f"ring_slots must be >= 0 (0 = lossless), "
                              f"got {self.ring_slots}")
+        if self.wire not in ("f32", "bf16", "qpack8"):
+            raise ValueError(f"wire must be 'f32', 'bf16' or 'qpack8', "
+                             f"got {self.wire!r}")
 
 
 @dataclass(frozen=True)

@@ -36,17 +36,28 @@ class VDIMetadata(NamedTuple):
     window_dims: jnp.ndarray   # i32[2] (width, height)
     nw: jnp.ndarray            # f32[] world-space step size ("nw" in reference)
     index: jnp.ndarray         # i32[] frame index
+    # i32[] payload precision code (ops.wire.WIRE_CODES: 0 = f32, the
+    # in-memory convention; 1 = qpack8, set by the host-side quantize
+    # pass of io.vdi_io / runtime.streaming so decoders know to
+    # dequantize). Readers (load_vdi / VDISubscriber) decode buffers back
+    # to f32 and keep the tag as provenance; writers always re-stamp it
+    # to match what they actually write, so an artifact/frame never
+    # mislabels its own buffers. Trailing with a default so 7-field
+    # constructions and pre-tag artifacts keep working.
+    precision: jnp.ndarray = np.int32(0)
 
     @classmethod
     def create(cls, projection, view, model=None, volume_dims=(0, 0, 0),
-               window_dims=(0, 0), nw: float = 0.0, index: int = 0) -> "VDIMetadata":
+               window_dims=(0, 0), nw: float = 0.0, index: int = 0,
+               precision: int = 0) -> "VDIMetadata":
         model = jnp.eye(4, dtype=jnp.float32) if model is None else jnp.asarray(model, jnp.float32)
         return cls(jnp.asarray(projection, jnp.float32),
                    jnp.asarray(view, jnp.float32), model,
                    jnp.asarray(volume_dims, jnp.float32),
                    jnp.asarray(window_dims, jnp.int32),
                    jnp.asarray(nw, jnp.float32),
-                   jnp.asarray(index, jnp.int32))
+                   jnp.asarray(index, jnp.int32),
+                   jnp.asarray(precision, jnp.int32))
 
 
 class VDI(NamedTuple):

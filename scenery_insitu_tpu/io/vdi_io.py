@@ -33,8 +33,7 @@ import numpy as np
 
 from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
 
-_META_FIELDS = ("projection", "view", "model", "volume_dims", "window_dims",
-                "nw", "index")
+_META_FIELDS = VDIMetadata._fields
 
 
 # ------------------------------------------------------------------ codecs
@@ -135,15 +134,48 @@ def decompress(data: bytes, codec: str = "zstd") -> bytes:
 # ----------------------------------------------------------- file artifacts
 
 def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
-             codec: str = "zstd") -> int:
+             codec: str = "zstd", precision: str = "f32") -> int:
     """Write a VDI (+ metadata) as one .npz artifact; returns bytes written.
 
     The npz members are individually compressed with ``codec`` (numpy's own
     deflate is off) so load/save round-trips are bit-exact and fast.
+
+    ``precision="qpack8"`` runs the sort-last wire quantizer
+    (ops.wire.qpack8_quantize_np; docs/PERF.md "Wire formats") as a
+    PRE-codec pass: the buffers shrink 4× (u8 color / u8×2 depth against
+    the stored [near, far]) before zstd/zlib even sees them, +inf empty
+    slots round-trip exactly through the 0xFFFF sentinel, and the tag is
+    recorded both in the artifact and in the metadata's ``precision``
+    field so ``load_vdi`` dequantizes back to f32 transparently. Lossy by
+    the wire contract — quantization error, not codec error.
     """
+    if precision not in ("f32", "qpack8"):
+        raise ValueError(f"precision must be 'f32' or 'qpack8', "
+                         f"got {precision!r}")
     codec = resolve_codec(codec)
-    members = {"color": np.asarray(vdi.color), "depth": np.asarray(vdi.depth),
-               "__codec__": np.frombuffer(codec.encode(), np.uint8)}
+    if precision == "qpack8":
+        from scenery_insitu_tpu.ops.wire import (WIRE_CODES,
+                                                 qpack8_quantize_np)
+
+        qc, qd, near, far = qpack8_quantize_np(np.asarray(vdi.color),
+                                               np.asarray(vdi.depth))
+        members = {"color": qc, "depth": qd,
+                   "__precision__": np.frombuffer(precision.encode(),
+                                                  np.uint8),
+                   "__qscale__": np.asarray([near, far], np.float32),
+                   "__codec__": np.frombuffer(codec.encode(), np.uint8)}
+        if meta is not None:
+            meta = meta._replace(
+                precision=np.int32(WIRE_CODES[precision]))
+    else:
+        members = {"color": np.asarray(vdi.color),
+                   "depth": np.asarray(vdi.depth),
+                   "__codec__": np.frombuffer(codec.encode(), np.uint8)}
+        if meta is not None:
+            # stamp what THIS artifact holds — a meta that rode in from a
+            # quantized hop (load_vdi / VDISubscriber keep the tag as
+            # provenance) must not mislabel the f32 buffers written here
+            meta = meta._replace(precision=np.int32(0))
     if meta is not None:
         for f in _META_FIELDS:
             members[f"meta_{f}"] = np.asarray(getattr(meta, f))
@@ -166,8 +198,15 @@ def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
 
 
 def load_vdi(path: str) -> Tuple[VDI, Optional[VDIMetadata]]:
+    """Inverse of ``save_vdi``. Honors the artifact's precision tag: a
+    ``qpack8``-quantized dump is dequantized back to f32 here, so every
+    reader sees the in-memory f32 convention regardless of how the bytes
+    were stored. Artifacts from before the precision tag load with the
+    f32 default."""
     with np.load(path) as z:
         codec = bytes(z["__codec__"]).decode() if "__codec__" in z else "none"
+        precision = (bytes(z["__precision__"]).decode()
+                     if "__precision__" in z else "f32")
 
         def member(k):
             if f"__shape__{k}" in z:
@@ -176,9 +215,18 @@ def load_vdi(path: str) -> Tuple[VDI, Optional[VDIMetadata]]:
                 return np.frombuffer(raw, dtype).reshape(z[f"__shape__{k}"])
             return z[k]
 
-        vdi = VDI(member("color"), member("depth"))
+        color, depth = member("color"), member("depth")
+        if precision == "qpack8":
+            from scenery_insitu_tpu.ops.wire import qpack8_dequantize_np
+
+            near, far = (float(x) for x in z["__qscale__"])
+            color, depth = qpack8_dequantize_np(color, depth, near, far)
+        vdi = VDI(color, depth)
         if "meta_projection" in z:
-            meta = VDIMetadata(*[member(f"meta_{f}") for f in _META_FIELDS])
+            # pre-tag artifacts carry no meta_precision member — default 0
+            meta = VDIMetadata(*[member(f"meta_{f}") if f"meta_{f}" in z
+                                 else np.int32(0)
+                                 for f in _META_FIELDS])
         else:
             meta = None
     return vdi, meta

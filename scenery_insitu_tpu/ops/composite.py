@@ -207,7 +207,8 @@ def merge_vdis_pairwise(color_a: jnp.ndarray, depth_a: jnp.ndarray,
 def modeled_exchange_traffic(n: int, k: int, height: int, width: int,
                              k_out: Optional[int] = None,
                              mode: str = "all_to_all", ring_slots: int = 0,
-                             itemsize: int = 4) -> dict:
+                             itemsize: int = 4,
+                             wire: str = "f32") -> dict:
     """Modeled per-rank bytes of the sort-last exchange + composite for
     one frame — the composite counterpart of
     ``sim.pallas_stencil.modeled_sim_traffic`` (probe-free, usable
@@ -216,16 +217,26 @@ def modeled_exchange_traffic(n: int, k: int, height: int, width: int,
 
     ``ici_bytes_per_rank`` is the wire traffic each rank ships (n-1
     K-fragments of its W/n column block — identical in both modes; the
-    ring only changes WHEN it moves and what must be live meanwhile).
+    ring only changes WHEN it moves and what must be live meanwhile). It
+    scales with the per-component ``wire`` itemsizes
+    (``ops.wire.WIRE_SLOT_BYTES``): f32 24 B/slot, bf16 12, qpack8 6 —
+    the model matches what the pipeline actually ships (qpack8's 8-byte
+    per-fragment [near, far] sideband is scalar noise and excluded).
     ``peak_stream_slots_per_pixel`` is the per-pixel working set of the
     merge: the all_to_all path materializes and sorts all N·K received
     slots; the capped ring holds ring_slots + K (accumulator + incoming
     fragment, e.g. 2K at ring_slots=K); the lossless ring (ring_slots=0)
-    grows back to N·K by the last hop.
+    grows back to N·K by the last hop. ``stream_bytes_per_rank`` is that
+    working set PLUS the resegmented ``k_out``-slot output write, both in
+    f32 ``itemsize`` — the composite always decodes to and folds in f32,
+    so HBM stream bytes do not shrink with the wire.
     """
+    from scenery_insitu_tpu.ops.wire import wire_slot_bytes
+
     wb = max(width // max(n, 1), 1)
-    seg = 6 * itemsize                        # 4 color + 2 depth per slot
-    frag = k * height * wb * seg
+    cb, db = wire_slot_bytes(wire)        # per-slot wire bytes (color, depth)
+    seg = 6 * itemsize                    # 4 color + 2 depth f32 HBM lanes
+    frag = k * height * wb * (cb + db)
     if mode == "ring" and ring_slots:
         slots = min(int(ring_slots), n * k) + k
     else:
@@ -233,9 +244,12 @@ def modeled_exchange_traffic(n: int, k: int, height: int, width: int,
     return {
         "mode": mode, "ranks": n, "k": k,
         "k_out": k_out, "ring_slots": ring_slots,
+        "wire": wire,
+        "wire_color_bytes_per_slot": cb,
+        "wire_depth_bytes_per_slot": db,
         "ici_bytes_per_rank": (n - 1) * frag,
         "peak_stream_slots_per_pixel": slots,
-        "stream_bytes_per_rank": slots * height * wb * seg,
+        "stream_bytes_per_rank": (slots + (k_out or 0)) * height * wb * seg,
     }
 
 

@@ -1,0 +1,282 @@
+"""Quantized supersegment wire formats for the sort-last exchange
+(docs/PERF.md "Wire formats").
+
+The sort-last composite ships every supersegment as 6 f32 lanes
+(24 B/slot) over ICI — in BOTH exchange schedules the per-rank wire
+traffic is ``(n-1)·K·H·(W/n)·24`` bytes per frame, and the PERF.md H2
+evidence says traffic-total reduction is the lever that pays on this
+platform. The reference system compresses VDIs before they cross process
+boundaries; the related compositing work does the same in flight (the
+Distributed FrameBuffer compresses every tile message, Usher et al.;
+deep compositing of unstructured data quantizes fragment payloads,
+Morrical et al.). Over ICI a byte-stream codec is off the table
+(collectives move typed arrays), so the equivalent lever is a narrower
+**element encoding** applied just before the collective and decoded just
+after it:
+
+``f32``     the identity — 24 B/slot, bit-exact (the default; the f32
+            code path is exactly the pre-wire pipeline).
+``bf16``    color + depth lanes cast to bfloat16 — 12 B/slot (2×).
+            ``+inf`` empty-slot depths survive the cast exactly; finite
+            values lose 16 mantissa bits (monotone rounding, so sorted
+            streams stay sorted).
+``qpack8``  premultiplied RGBA packed to u8 unorm in one u32 lane
+            (4 B/slot) and the (start, end) depth pair quantized to u8
+            each against per-fragment ``[near, far]`` f32 scalars
+            carried alongside, packed into one u16 lane (2 B/slot) —
+            6 B/slot, 4×. The u16 sentinel ``0xFFFF`` (byte sentinel
+            ``0xFF`` per depth) is reserved to round-trip ``+inf``
+            empty slots EXACTLY, so the merge/re-segmentation empty-slot
+            convention (``ops.composite``) is untouched; live bytes are
+            clamped to ``0..254`` so no live pair can collide with the
+            sentinel. The start byte occupies the high half, so u16
+            ordering == (start, end) lexicographic ordering.
+
+Quantized modes are lossy BY CONTRACT: the quantization error is bounded
+by one color quantum (1/255 per channel) and one depth quantum
+(fragment depth span / 254). Because each rank normalizes against its
+OWN fragment's [near, far] — a z-slab's ray-parameter range, roughly 1/n
+of the scene's — the effective depth resolution scales with the mesh.
+Both quantizers are monotone, so a per-pixel depth-sorted stream decodes
+depth-sorted (the pairwise-merge precondition of the ring schedule).
+
+The numpy twins (``qpack8_quantize_np``/``qpack8_dequantize_np``) are
+the host-side reuse of the same format: ``io.vdi_io.save_vdi`` and
+``runtime.streaming.VDIPublisher`` run them as a pre-codec pass so the
+disk/DCN hop gets the same 4× before zstd/zlib even sees the buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+WIRE_FORMATS = ("f32", "bf16", "qpack8")
+
+# precision codes for VDIMetadata.precision / stored-artifact tags
+WIRE_CODES = {"f32": 0, "qpack8": 1, "bf16": 2}
+
+# per-supersegment-slot wire bytes: (color, depth). f32: 4 lanes * 4 B +
+# 2 lanes * 4 B; bf16 halves both; qpack8 is one u32 color lane + one
+# u16 packed depth-pair lane. Consumed by the traffic model
+# (ops.composite.modeled_exchange_traffic).
+WIRE_SLOT_BYTES = {"f32": (16, 8), "bf16": (8, 4), "qpack8": (4, 2)}
+
+_QMAX = 254          # live depth codes span 0..254; 255 is the +inf sentinel
+_SENTINEL = 255
+
+
+def wire_slot_bytes(wire: str) -> Tuple[int, int]:
+    """(color_bytes, depth_bytes) one supersegment slot costs on the wire."""
+    try:
+        return WIRE_SLOT_BYTES[wire]
+    except KeyError:
+        raise ValueError(f"unknown wire format {wire!r}; have {WIRE_FORMATS}")
+
+
+def _count_encode(wire: str, cb: int, db: int) -> None:
+    """Host-side trace-time marker: one per encoded fragment build
+    (docs/OBSERVABILITY.md wire counters)."""
+    from scenery_insitu_tpu import obs as _obs
+
+    rec = _obs.get_recorder()
+    rec.count("wire_encode_builds")
+    rec.event("wire_encode", wire=wire, bytes_per_slot=cb + db)
+
+
+def _depth_scale(depth: jnp.ndarray):
+    """Per-fragment [near, far] over the finite depths, pinned to [0, 1]
+    when the fragment is fully empty and to a unit span when near == far
+    so the quantize arithmetic stays finite. Returns
+    (finite_mask, near, far, enc_span)."""
+    finite = jnp.isfinite(depth)
+    near = jnp.min(jnp.where(finite, depth, jnp.inf))
+    far = jnp.max(jnp.where(finite, depth, -jnp.inf))
+    ok = jnp.isfinite(near) & jnp.isfinite(far)
+    near = jnp.where(ok, near, jnp.float32(0.0))
+    far = jnp.where(ok, far, jnp.float32(1.0))
+    span = far - near
+    enc_span = jnp.where(span > 0, span, jnp.float32(1.0))
+    return finite, near, far, enc_span
+
+
+def _bcast_scale(scale: jnp.ndarray, ndim: int):
+    """Split a [..., 2] scale into (near, far) reshaped to broadcast
+    against an ndim-D encoded array (leading batch dims align)."""
+    near, far = scale[..., 0], scale[..., 1]
+    pad = (1,) * (ndim - near.ndim)
+    return near.reshape(near.shape + pad), far.reshape(far.shape + pad)
+
+
+def _pack_rgba(color: jnp.ndarray) -> jnp.ndarray:
+    """[..., 4, H, W] f32 in [0, 1] → u32[..., H, W] (R|G<<8|B<<16|A<<24)."""
+    c8 = jnp.round(jnp.clip(color, 0.0, 1.0) * 255.0).astype(jnp.uint32)
+    return (c8[..., 0, :, :] | (c8[..., 1, :, :] << 8)
+            | (c8[..., 2, :, :] << 16) | (c8[..., 3, :, :] << 24))
+
+
+def _unpack_rgba(enc: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`_pack_rgba` → f32[..., 4, H, W]."""
+    return jnp.stack([(enc >> s) & 0xFF for s in (0, 8, 16, 24)],
+                     axis=-3).astype(jnp.float32) / 255.0
+
+
+# ------------------------------------------------------------- VDI fragments
+
+def encode_fragment(color: jnp.ndarray, depth: jnp.ndarray, wire: str
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                               Optional[jnp.ndarray]]:
+    """Encode one VDI fragment (color [..., 4, H, W] premultiplied f32,
+    depth [..., 2, H, W] f32 with +inf empty slots) for the wire.
+
+    Returns ``(color_enc, depth_enc, scale)``. ``scale`` is the
+    ``f32[2]`` per-fragment ``[near, far]`` depth normalization (qpack8
+    only; None otherwise) — it must travel WITH the fragment (ppermute it
+    alongside, or all_gather it across the all_to_all). For qpack8 the
+    channel axes are packed away: color → u32[..., H, W]
+    (R | G<<8 | B<<16 | A<<24), depth → u16[..., H, W]
+    (start_q<<8 | end_q)."""
+    if wire == "f32":
+        return color, depth, None
+    if wire == "bf16":
+        _count_encode(wire, *WIRE_SLOT_BYTES[wire])
+        return (color.astype(jnp.bfloat16), depth.astype(jnp.bfloat16),
+                None)
+    if wire != "qpack8":
+        raise ValueError(f"unknown wire format {wire!r}; have {WIRE_FORMATS}")
+    _count_encode(wire, *WIRE_SLOT_BYTES[wire])
+
+    finite, near, far, enc_span = _depth_scale(depth)
+    q = jnp.clip(jnp.round((depth - near) / enc_span * _QMAX), 0.0,
+                 float(_QMAX))
+    q = jnp.where(finite, q, float(_SENTINEL)).astype(jnp.uint16)
+    d16 = (q[..., 0, :, :] << 8) | q[..., 1, :, :]          # u16[..., H, W]
+    return _pack_rgba(color), d16, jnp.stack([near, far])
+
+
+def decode_fragment(color_enc: jnp.ndarray, depth_enc: jnp.ndarray,
+                    scale: Optional[jnp.ndarray], wire: str
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Inverse of :func:`encode_fragment` → f32 (color [..., 4, H, W],
+    depth [..., 2, H, W]). ``scale`` may carry leading batch dims
+    ([..., 2], e.g. [n, 2] per-source after an all_to_all + all_gather)
+    that broadcast against the fragment's leading dims."""
+    if wire == "f32":
+        return color_enc, depth_enc
+    if wire == "bf16":
+        return (color_enc.astype(jnp.float32),
+                depth_enc.astype(jnp.float32))
+    if wire != "qpack8":
+        raise ValueError(f"unknown wire format {wire!r}; have {WIRE_FORMATS}")
+
+    near, far = _bcast_scale(scale, depth_enc.ndim)
+    span = jnp.maximum(far - near, 0.0)
+
+    qs = (depth_enc >> 8).astype(jnp.float32)
+    qe = (depth_enc & 0xFF).astype(jnp.float32)
+    ds = jnp.where((depth_enc >> 8) == _SENTINEL, jnp.inf,
+                   near + qs / _QMAX * span)
+    de = jnp.where((depth_enc & 0xFF) == _SENTINEL, jnp.inf,
+                   near + qe / _QMAX * span)
+    return _unpack_rgba(color_enc), jnp.stack([ds, de], axis=-3)
+
+
+# ------------------------------------------------------ plain-image fragments
+
+def encode_plain(image: jnp.ndarray, depth: jnp.ndarray, wire: str
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                            Optional[jnp.ndarray]]:
+    """Wire-encode a plain fragment (image [..., 4, H, W] premultiplied,
+    depth [..., H, W] with +inf empty pixels). qpack8 here is
+    RGBA→u32 + ONE u16 depth per pixel over the full 0..65534 range
+    (sentinel 0xFFFF = +inf) — the single plain depth gets the whole u16
+    instead of sharing it with an end depth."""
+    if wire == "f32":
+        return image, depth, None
+    if wire == "bf16":
+        _count_encode(wire, *WIRE_SLOT_BYTES[wire])
+        return (image.astype(jnp.bfloat16), depth.astype(jnp.bfloat16),
+                None)
+    if wire != "qpack8":
+        raise ValueError(f"unknown wire format {wire!r}; have {WIRE_FORMATS}")
+    _count_encode(wire, *WIRE_SLOT_BYTES[wire])
+
+    qmax = 65534.0                       # 0xFFFF is the +inf sentinel
+    finite, near, far, enc_span = _depth_scale(depth)
+    q = jnp.clip(jnp.round((depth - near) / enc_span * qmax), 0.0, qmax)
+    d16 = jnp.where(finite, q, 65535.0).astype(jnp.uint16)
+    return _pack_rgba(image), d16, jnp.stack([near, far])
+
+
+def decode_plain(image_enc: jnp.ndarray, depth_enc: jnp.ndarray,
+                 scale: Optional[jnp.ndarray], wire: str
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Inverse of :func:`encode_plain` → f32 (image [..., 4, H, W],
+    depth [..., H, W])."""
+    if wire == "f32":
+        return image_enc, depth_enc
+    if wire == "bf16":
+        return (image_enc.astype(jnp.float32),
+                depth_enc.astype(jnp.float32))
+    if wire != "qpack8":
+        raise ValueError(f"unknown wire format {wire!r}; have {WIRE_FORMATS}")
+
+    near, far = _bcast_scale(scale, depth_enc.ndim)
+    span = jnp.maximum(far - near, 0.0)
+    depth = jnp.where(depth_enc == 0xFFFF, jnp.inf,
+                      near + depth_enc.astype(jnp.float32) / 65534.0 * span)
+    return _unpack_rgba(image_enc), depth
+
+
+# -------------------------------------------------------- host-side (numpy)
+
+def qpack8_quantize_np(color: np.ndarray, depth: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray, float, float]:
+    """Numpy twin of the qpack8 VDI encode, for the host hop (vdi_io /
+    VDIPublisher pre-codec pass). color f32[K, 4, H, W],
+    depth f32[K, 2, H, W] → (color u32[K, H, W], depth u16[K, H, W],
+    near, far). Bit-identical codes to the device encode."""
+    color = np.asarray(color, np.float32)
+    depth = np.asarray(depth, np.float32)
+    finite = np.isfinite(depth)
+    if finite.any():
+        near = float(depth[finite].min())
+        far = float(depth[finite].max())
+    else:
+        near, far = 0.0, 1.0
+    span = far - near
+    enc_span = span if span > 0 else 1.0
+    with np.errstate(invalid="ignore"):
+        q = np.clip(np.round((depth - np.float32(near))
+                             / np.float32(enc_span) * _QMAX), 0.0,
+                    float(_QMAX))
+    q = np.where(finite, q, float(_SENTINEL)).astype(np.uint16)
+    d16 = ((q[..., 0, :, :] << np.uint16(8)) | q[..., 1, :, :])
+    c8 = np.round(np.clip(color, 0.0, 1.0) * 255.0).astype(np.uint32)
+    c32 = (c8[..., 0, :, :] | (c8[..., 1, :, :] << np.uint32(8))
+           | (c8[..., 2, :, :] << np.uint32(16))
+           | (c8[..., 3, :, :] << np.uint32(24)))
+    return c32, d16.astype(np.uint16), near, far
+
+
+def qpack8_dequantize_np(color_enc: np.ndarray, depth_enc: np.ndarray,
+                         near: float, far: float
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`qpack8_quantize_np` → f32 (color [K, 4, H, W],
+    depth [K, 2, H, W])."""
+    color_enc = np.asarray(color_enc, np.uint32)
+    depth_enc = np.asarray(depth_enc, np.uint16)
+    span = max(float(far) - float(near), 0.0)
+    qs = (depth_enc >> np.uint16(8)).astype(np.float32)
+    qe = (depth_enc & np.uint16(0xFF)).astype(np.float32)
+    ds = np.where((depth_enc >> np.uint16(8)) == _SENTINEL, np.inf,
+                  np.float32(near) + qs / _QMAX * np.float32(span))
+    de = np.where((depth_enc & np.uint16(0xFF)) == _SENTINEL, np.inf,
+                  np.float32(near) + qe / _QMAX * np.float32(span))
+    depth = np.stack([ds, de], axis=-3).astype(np.float32)
+    color = np.stack([(color_enc >> np.uint32(s)) & np.uint32(0xFF)
+                      for s in (0, 8, 16, 24)],
+                     axis=-3).astype(np.float32) / 255.0
+    return color, depth

@@ -27,6 +27,13 @@ XLA's async collectives fly the next hop while the current fragment
 merges, and with ``ring_slots`` set the per-pixel live state is bounded
 at ring_slots + K instead of N·K.
 
+Orthogonally, ``CompositeConfig.wire`` picks the supersegment encoding
+that actually crosses ICI in either schedule (docs/PERF.md "Wire
+formats"; ops/wire.py): fragments are encoded just before the collective
+and decoded right after it — ``f32`` (bit-exact), ``bf16`` (12 B/slot,
+2×) or ``qpack8`` (u8 color + u8×2 depth against per-fragment [near,
+far] scalars, 6 B/slot, 4×). The merge/composite always runs in f32.
+
 Decomposition is 1-D over the volume z axis with one-voxel halo exchange,
 making distributed trilinear sampling seam-exact vs a single-device render
 (tests assert PSNR, test_parallel.py).
@@ -97,6 +104,39 @@ def _take_block(blocks: jnp.ndarray, j) -> jnp.ndarray:
     return jax.lax.dynamic_index_in_dim(blocks, j, axis=0, keepdims=False)
 
 
+def _encoded_all_to_all(a: jnp.ndarray, b: jnp.ndarray, n: int,
+                        axis_name: str, encode, decode):
+    """Wire-aware all_to_all column exchange (docs/PERF.md "Wire
+    formats"): ``encode`` the pair before the collective, ``decode``
+    after it, so only the narrow encoding crosses ICI. The per-fragment
+    scale (qpack8) has no W axis to split — it rides an ``all_gather``
+    so every rank decodes each source fragment against its SENDER's
+    normalization ([n, 2], row order == all_to_all's source order)."""
+    enc_a, enc_b, scale = encode(a, b)
+    ra = _exchange_columns(enc_a, n, axis_name)
+    rb = _exchange_columns(enc_b, n, axis_name)
+    scales = (jax.lax.all_gather(scale, axis_name)
+              if scale is not None else None)
+    return decode(ra, rb, scales)
+
+
+def _exchange_vdi_columns(color: jnp.ndarray, depth: jnp.ndarray,
+                          n: int, axis_name: str, wire: str):
+    """All_to_all column exchange of a VDI fragment under
+    ``CompositeConfig.wire``. ``wire == "f32"`` is exactly the pre-wire
+    exchange. Returns f32 ([n, K, 4, H, W/n], [n, K, 2, H, W/n]) with
+    the leading axis indexing the source rank."""
+    if wire == "f32":
+        return (_exchange_columns(color, n, axis_name),
+                _exchange_columns(depth, n, axis_name))
+    from scenery_insitu_tpu.ops import wire as _wire
+
+    return _encoded_all_to_all(
+        color, depth, n, axis_name,
+        lambda c, d: _wire.encode_fragment(c, d, wire),
+        lambda c, d, s: _wire.decode_fragment(c, d, s, wire))
+
+
 def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
                              n: int, axis_name: str, cfg,
                              gap_eps: float = 1e-4):
@@ -138,10 +178,10 @@ def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
     rec = _obs.get_recorder()
     rec.count("ring_exchange_builds")
     rec.event("ring_exchange_build", ranks=n, k=k,
-              slots=(cap or n * k),
+              slots=(cap or n * k), wire=cfg.wire,
               traffic=modeled_exchange_traffic(
                   n, k, h, w, k_out=cfg.max_output_supersegments,
-                  mode="ring", ring_slots=cfg.ring_slots))
+                  mode="ring", ring_slots=cfg.ring_slots, wire=cfg.wire))
 
     # one K-wide per-pixel sort + stale-color mask of the LOCAL fragment
     # replaces the all_to_all path's N·K-wide post-exchange sort (the VDI
@@ -152,11 +192,28 @@ def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
     depth = jnp.take_along_axis(depth, order[:, None], axis=0)
     color = jnp.where(jnp.isfinite(depth[:, 0])[:, None], color, 0.0)
 
-    blk_c = _column_blocks(color, n)                  # [n, K, 4, H, W/n]
-    blk_d = _column_blocks(depth, n)
+    # wire encode ONCE on the local fragment; every hop ships the narrow
+    # encoding and decodes on receive (docs/PERF.md "Wire formats"). The
+    # own block round-trips the codec too, so the accumulator sees the
+    # same quantization the all_to_all path applies to every fragment —
+    # both schedules degrade identically under a lossy wire. Quantizers
+    # are monotone, so the pre-sorted stream decodes sorted (the
+    # pairwise-merge precondition). f32 keeps the pre-wire ops exactly.
+    from scenery_insitu_tpu.ops import wire as _wire
+    if cfg.wire == "f32":
+        enc_c, enc_d, scale = color, depth, None
+    else:
+        enc_c, enc_d, scale = _wire.encode_fragment(color, depth, cfg.wire)
+
+    def dec(c, d, sc):
+        return _wire.decode_fragment(c, d, sc, cfg.wire)
+
+    blk_c = _column_blocks(enc_c, n)                  # [n, K, ..., H, W/n]
+    blk_d = _column_blocks(enc_d, n)
     r = jax.lax.axis_index(axis_name)
-    acc_c, acc_d = _take_block(blk_c, r), _take_block(blk_d, r)
-    frag_bytes = (blk_c.size + blk_d.size) // n * color.dtype.itemsize
+    acc_c, acc_d = dec(_take_block(blk_c, r), _take_block(blk_d, r), scale)
+    frag_bytes = (blk_c.size * blk_c.dtype.itemsize
+                  + blk_d.size * blk_d.dtype.itemsize) // n
     for s in range(1, n):
         # rank i ships its block for rank i-s; receiver r hears from r+s
         perm = [(i, (i - s) % n) for i in range(n)]
@@ -164,9 +221,13 @@ def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
         send_d = _take_block(blk_d, jnp.mod(r - s, n))
         recv_c = jax.lax.ppermute(send_c, axis_name, perm)
         recv_d = jax.lax.ppermute(send_d, axis_name, perm)
+        recv_s = (jax.lax.ppermute(scale, axis_name, perm)
+                  if scale is not None else None)
         rec.count("ring_steps_built")
-        rec.event("ring_step", step=s, hops=s, frag_bytes=frag_bytes)
-        acc_c, acc_d = merge_vdis_pairwise(acc_c, acc_d, recv_c, recv_d,
+        rec.event("ring_step", step=s, hops=s, frag_bytes=frag_bytes,
+                  wire=cfg.wire)
+        mc, md = dec(recv_c, recv_d, recv_s)
+        acc_c, acc_d = merge_vdis_pairwise(acc_c, acc_d, mc, md,
                                            k_cap=cap)
     return resegment_stream(acc_c, acc_d, cfg, gap_eps)
 
@@ -181,36 +242,53 @@ def _composite_exchanged(color: jnp.ndarray, depth: jnp.ndarray,
     if comp_cfg.exchange == "ring" and n > 1:
         return _ring_exchange_composite(color, depth, n, axis_name,
                                         comp_cfg)
-    colors = _exchange_columns(color, n, axis_name)   # [n, K, 4, H, W/n]
-    depths = _exchange_columns(depth, n, axis_name)
+    colors, depths = _exchange_vdi_columns(color, depth, n, axis_name,
+                                           comp_cfg.wire)
     return composite_vdis(colors, depths, comp_cfg)
 
 
 def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
-                         n: int, axis_name: str):
+                         n: int, axis_name: str, wire: str = "f32"):
     """Ring schedule for the plain-image exchange: n-1 single-fragment
     ppermute hops (pipelined like the VDI ring), then the stacked
     fragments are rolled back into SOURCE-RANK order so the downstream
     `composite_plain` sees the exact [n, ...] layout the all_to_all
-    delivers — bitwise-identical output. Plain fragments are one
-    RGBA+depth per pixel, so there is no N·K working set to cap; the win
-    is purely the pipelined exchange. Returns (images [n, 4, H, W/n],
+    delivers — bitwise-identical output at ``wire="f32"``. Plain
+    fragments are one RGBA+depth per pixel, so there is no N·K working
+    set to cap; the win is the pipelined exchange, and a quantized wire
+    (docs/PERF.md "Wire formats") shrinks what each hop moves — hops ship
+    the encoding and decode on receive. Returns (images [n, 4, H, W/n],
     depths [n, H, W/n])."""
     from scenery_insitu_tpu import obs as _obs
+    from scenery_insitu_tpu.ops import wire as _wire
 
-    blk_i = _column_blocks(image, n)                  # [n, 4, H, W/n]
-    blk_d = _column_blocks(depth, n)                  # [n, H, W/n]
+    if wire == "f32":
+        enc_i, enc_d, scale = image, depth, None
+    else:
+        enc_i, enc_d, scale = _wire.encode_plain(image, depth, wire)
+
+    def dec(i, d, sc):
+        return _wire.decode_plain(i, d, sc, wire)
+
+    blk_i = _column_blocks(enc_i, n)                  # [n, ..., H, W/n]
+    blk_d = _column_blocks(enc_d, n)                  # [n, H, W/n]
     r = jax.lax.axis_index(axis_name)
     rec = _obs.get_recorder()
     rec.count("ring_exchange_builds")
-    frags_i = [_take_block(blk_i, r)]
-    frags_d = [_take_block(blk_d, r)]
+    own_i, own_d = dec(_take_block(blk_i, r), _take_block(blk_d, r), scale)
+    frags_i = [own_i]
+    frags_d = [own_d]
     for s in range(1, n):
         perm = [(i, (i - s) % n) for i in range(n)]
-        frags_i.append(jax.lax.ppermute(
-            _take_block(blk_i, jnp.mod(r - s, n)), axis_name, perm))
-        frags_d.append(jax.lax.ppermute(
-            _take_block(blk_d, jnp.mod(r - s, n)), axis_name, perm))
+        recv_i = jax.lax.ppermute(
+            _take_block(blk_i, jnp.mod(r - s, n)), axis_name, perm)
+        recv_d = jax.lax.ppermute(
+            _take_block(blk_d, jnp.mod(r - s, n)), axis_name, perm)
+        recv_s = (jax.lax.ppermute(scale, axis_name, perm)
+                  if scale is not None else None)
+        di, dd = dec(recv_i, recv_d, recv_s)
+        frags_i.append(di)
+        frags_d.append(dd)
         rec.count("ring_steps_built")
     stacked_i = jnp.stack(frags_i)          # arrival order: r, r+1, ...
     stacked_d = jnp.stack(frags_d)
@@ -220,14 +298,23 @@ def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
 
 def _composite_plain_exchanged(image: jnp.ndarray, depth: jnp.ndarray,
                                n: int, axis_name: str, background,
-                               exchange: str):
+                               exchange: str, wire: str = "f32"):
     """Plain-image exchange + nearest-first composite under the configured
-    schedule (`exchange` ∈ {"all_to_all", "ring"})."""
+    schedule (`exchange` ∈ {"all_to_all", "ring"}) and wire format
+    (`wire` ∈ {"f32", "bf16", "qpack8"})."""
     if exchange == "ring" and n > 1:
-        images, depths = _ring_exchange_plain(image, depth, n, axis_name)
-    else:
+        images, depths = _ring_exchange_plain(image, depth, n, axis_name,
+                                              wire)
+    elif wire == "f32":
         images = _exchange_columns(image, n, axis_name)  # [n, 4, H, W/n]
         depths = _exchange_columns(depth, n, axis_name)  # [n, H, W/n]
+    else:
+        from scenery_insitu_tpu.ops import wire as _wire
+
+        images, depths = _encoded_all_to_all(
+            image, depth, n, axis_name,
+            lambda i, d: _wire.encode_plain(i, d, wire),
+            lambda i, d, s: _wire.decode_plain(i, d, s, wire))
     return composite_plain(images, depths, background)
 
 
@@ -579,7 +666,8 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
 def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                spec, cfg: Optional[RenderConfig] = None,
                                axis_name: Optional[str] = None,
-                               exchange: str = "all_to_all"):
+                               exchange: str = "all_to_all",
+                               wire: str = "f32"):
     """Distributed plain-image rendering on the MXU slice-march engine —
     the TPU-fast counterpart of `distributed_plain_step` (the reference's
     non-VDI mode, VolumeRaycaster.comp:94-161 composited by
@@ -598,9 +686,12 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
 
     ``exchange``: "all_to_all" (one collective) or "ring" (n-1 pipelined
     single-fragment ppermute hops; bitwise-identical output — see
-    `_ring_exchange_plain`). Plain steps take the knob directly because
+    `_ring_exchange_plain`). ``wire``: the fragment encoding that crosses
+    ICI ("f32" bit-exact | "bf16" | "qpack8" — docs/PERF.md "Wire
+    formats"; lossy modes quantize the exchanged RGBA+depth only, the
+    composite runs in f32). Plain steps take both knobs directly because
     they carry no CompositeConfig; the session forwards
-    ``cfg.composite.exchange``.
+    ``cfg.composite.exchange`` / ``cfg.composite.wire``.
     """
     from scenery_insitu_tpu.ops import slicer
 
@@ -639,7 +730,7 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
         # rank partials stay background-free; the display warp blends it
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
                                           (0.0, 0.0, 0.0, 0.0),
-                                          exchange), axcam
+                                          exchange, wire), axcam
 
     from scenery_insitu_tpu.ops.slicer import AxisCamera
     out_axcam = AxisCamera(*(P() for _ in AxisCamera._fields))
@@ -654,13 +745,15 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            width: int, height: int,
                            cfg: Optional[RenderConfig] = None,
                            axis_name: Optional[str] = None,
-                           exchange: str = "all_to_all"):
+                           exchange: str = "all_to_all",
+                           wire: str = "f32"):
     """Build the jitted distributed plain-image render step (the reference's
     non-VDI mode: VolumeRaycaster + PlainImageCompositor,
     DistributedVolumeRenderer.kt:175-189). Returns ``f(vol_data, origin,
     spacing, cam) -> image f32[4, height, width]`` sharded by W.
     ``exchange`` selects the column-exchange schedule ("all_to_all" |
-    "ring" — see `distributed_plain_step_mxu`)."""
+    "ring") and ``wire`` the fragment encoding that crosses ICI — see
+    `distributed_plain_step_mxu`."""
     cfg = cfg or RenderConfig(width=width, height=height)
     axis = axis_name or mesh.axis_names[0]
     n = mesh.shape[axis]
@@ -698,7 +791,7 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
         out = raycast(vol, tf, cam, width, height, rank_cfg,
                       clip_min=cmin, clip_max=cmax, ao_field=ao_vol)
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
-                                          cfg.background, exchange)
+                                          cfg.background, exchange, wire)
 
     f = shard_map(step, mesh=mesh,
                   in_specs=(P(axis, None, None), P(), P(), P()),

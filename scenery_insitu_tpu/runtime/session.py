@@ -341,7 +341,8 @@ class InSituSession:
             self.mode = "plain"
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r,
-                exchange=self.cfg.composite.exchange)
+                exchange=self.cfg.composite.exchange,
+                wire=self.cfg.composite.wire)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
@@ -815,7 +816,8 @@ class InSituSession:
                                           multiple_of=n)
             step = distributed_plain_step_mxu(
                 self.mesh, self.tf, spec, self.cfg.render,
-                exchange=self.cfg.composite.exchange)
+                exchange=self.cfg.composite.exchange,
+                wire=self.cfg.composite.wire)
             r = self.cfg.render
             slicer = self._slicer
 

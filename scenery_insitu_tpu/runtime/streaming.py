@@ -32,8 +32,7 @@ from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
 from scenery_insitu_tpu.io.vdi_io import compress, decompress
 
-_META_FIELDS = ("projection", "view", "model", "volume_dims", "window_dims",
-                "nw", "index")
+_META_FIELDS = VDIMetadata._fields
 
 
 def _msgpack():
@@ -49,18 +48,30 @@ def _zmq():
 # --------------------------------------------------------------- VDI stream
 
 class VDIPublisher:
-    """PUB endpoint streaming (metadata, color, depth) per frame."""
+    """PUB endpoint streaming (metadata, color, depth) per frame.
+
+    ``precision="qpack8"`` runs the sort-last wire quantizer
+    (ops.wire.qpack8_quantize_np; docs/PERF.md "Wire formats") as a
+    pre-codec pass on every frame: buffers shrink 4× BEFORE the byte
+    codec, the [near, far] scale and the precision tag travel in the
+    frame header, and the metadata's ``precision`` field is stamped so
+    subscribers (which dequantize transparently) and any archived
+    headers agree on what the bytes are. Lossy by the wire contract."""
 
     def __init__(self, bind: str = "tcp://*:6655", codec: str = "zstd",
-                 level: int = -1):
+                 level: int = -1, precision: str = "f32"):
         from scenery_insitu_tpu.io.vdi_io import resolve_codec
 
+        if precision not in ("f32", "qpack8"):
+            raise ValueError(f"precision must be 'f32' or 'qpack8', "
+                             f"got {precision!r}")
         zmq = _zmq()
         # degrade the default codec when the optional zstandard package
         # is absent (the resolved name travels in every frame header, so
         # subscribers stay consistent)
         self.codec = resolve_codec(codec)
         self.level = level
+        self.precision = precision
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.PUB)
         if bind.endswith(":0"):                      # ephemeral port for tests
@@ -77,13 +88,31 @@ class VDIPublisher:
 
         with _obs.get_recorder().span(
                 "encode", frame=int(np.asarray(meta.index)),
-                sink="vdi_publisher", codec=self.codec):
+                sink="vdi_publisher", codec=self.codec,
+                precision=self.precision):
             color = np.ascontiguousarray(np.asarray(vdi.color))
             depth = np.ascontiguousarray(np.asarray(vdi.depth))
-            cblob = compress(color.tobytes(), self.codec, self.level)
-            dblob = compress(depth.tobytes(), self.codec, self.level)
+            qscale = None
+            if self.precision == "qpack8":
+                from scenery_insitu_tpu.ops.wire import (WIRE_CODES,
+                                                         qpack8_quantize_np)
+
+                color, depth, near, far = qpack8_quantize_np(color, depth)
+                qscale = [float(near), float(far)]
+                meta = meta._replace(
+                    precision=np.int32(WIRE_CODES[self.precision]))
+            else:
+                # stamp what THIS frame ships — a meta that rode in from a
+                # quantized hop must not mislabel the f32 buffers sent here
+                meta = meta._replace(precision=np.int32(0))
+            cblob = compress(np.ascontiguousarray(color).tobytes(),
+                             self.codec, self.level)
+            dblob = compress(np.ascontiguousarray(depth).tobytes(),
+                             self.codec, self.level)
             header = _msgpack().packb({
                 "codec": self.codec,
+                "precision": self.precision,
+                "qscale": qscale,
                 "color_shape": list(color.shape),
                 "depth_shape": list(depth.shape),
                 "meta": {f: np.asarray(getattr(meta, f)).tolist()
@@ -115,10 +144,23 @@ class VDISubscriber:
                 return None
         header, cblob, dblob = self.sock.recv_multipart()
         h = _msgpack().unpackb(header)
-        color = np.frombuffer(decompress(cblob, h["codec"]), np.float32) \
-            .reshape(h["color_shape"])
-        depth = np.frombuffer(decompress(dblob, h["codec"]), np.float32) \
-            .reshape(h["depth_shape"])
+        precision = h.get("precision", "f32")
+        if precision == "qpack8":
+            # the publisher's pre-codec quantize pass (header carries the
+            # [near, far] scale): dequantize back to the f32 convention
+            from scenery_insitu_tpu.ops.wire import qpack8_dequantize_np
+
+            qc = np.frombuffer(decompress(cblob, h["codec"]), np.uint32) \
+                .reshape(h["color_shape"])
+            qd = np.frombuffer(decompress(dblob, h["codec"]), np.uint16) \
+                .reshape(h["depth_shape"])
+            near, far = h["qscale"]
+            color, depth = qpack8_dequantize_np(qc, qd, near, far)
+        else:
+            color = np.frombuffer(decompress(cblob, h["codec"]), np.float32) \
+                .reshape(h["color_shape"])
+            depth = np.frombuffer(decompress(dblob, h["codec"]), np.float32) \
+                .reshape(h["depth_shape"])
         m = h["meta"]
         meta = VDIMetadata.create(
             projection=np.asarray(m["projection"], np.float32),
@@ -126,7 +168,8 @@ class VDISubscriber:
             model=np.asarray(m["model"], np.float32),
             volume_dims=np.asarray(m["volume_dims"], np.float32),
             window_dims=np.asarray(m["window_dims"], np.int32),
-            nw=float(np.asarray(m["nw"])), index=int(np.asarray(m["index"])))
+            nw=float(np.asarray(m["nw"])), index=int(np.asarray(m["index"])),
+            precision=int(np.asarray(m.get("precision", 0))))
         return VDI(color, depth), meta
 
     def close(self) -> None:

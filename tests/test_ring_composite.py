@@ -376,11 +376,16 @@ def test_ring_build_emits_obs_counters():
 
 
 def test_modeled_exchange_traffic_memory_model():
-    """The N·K → ring_slots+K working-set reduction the docs claim."""
+    """The N·K → ring_slots+K working-set reduction the docs claim.
+    stream_bytes_per_rank covers the merge working set PLUS the
+    resegmented k_out-slot output write (k_out used to be echoed but
+    never accounted)."""
     a2a = modeled_exchange_traffic(8, 16, 720, 1280, k_out=16)
     ring = modeled_exchange_traffic(8, 16, 720, 1280, k_out=16,
                                     mode="ring", ring_slots=16)
     assert a2a["peak_stream_slots_per_pixel"] == 8 * 16
     assert ring["peak_stream_slots_per_pixel"] == 2 * 16
     assert ring["ici_bytes_per_rank"] == a2a["ici_bytes_per_rank"]
-    assert ring["stream_bytes_per_rank"] * 4 == a2a["stream_bytes_per_rank"]
+    px = 720 * (1280 // 8)
+    assert a2a["stream_bytes_per_rank"] == (8 * 16 + 16) * px * 24
+    assert ring["stream_bytes_per_rank"] == (2 * 16 + 16) * px * 24

@@ -216,9 +216,19 @@ def main():
     # accept the long spellings; config validation only knows the short
     render_dtype = {"bfloat16": "bf16", "float32": "f32"}.get(render_dtype,
                                                               render_dtype)
-    # in-plane occupancy tiles (0 = chunk skipping only; try 8 on sparse
-    # fields — see SliceMarchConfig.occupancy_vtiles)
-    vtiles = _env_int("SITPU_BENCH_VTILES", 0)
+    # in-plane occupancy tiles (0 = chunk skipping only; -1 = the
+    # backend-resolved default, 16 on TPU — see
+    # SliceMarchConfig.occupancy_vtiles)
+    vtiles = _env_int("SITPU_BENCH_VTILES", -1)
+    # empty-space-skipping A/B ladder (docs/PERF.md "Empty-space
+    # skipping"; benchmarks/occupancy_bench.py is the dedicated A/B):
+    # off | chunk | pyramid | sim — unset keeps the slicer-config
+    # defaults (skip on, vtiles as above). "sim" feeds the march's
+    # occupancy pyramid from ranges riding the fused sim stencil.
+    skip_mode = os.environ.get("SITPU_BENCH_SKIP") or None
+    if skip_mode not in (None, "off", "chunk", "pyramid", "sim"):
+        raise ValueError(f"SITPU_BENCH_SKIP must be off|chunk|pyramid|sim,"
+                         f" got {skip_mode!r}")
     # sim-fusion lever A/B: 0 pins the XLA roll formulation (the un-fused
     # baseline the time-fused Pallas stencil is measured against)
     sim_fused = bool(_env_int("SITPU_BENCH_SIM_FUSED", 1))
@@ -248,8 +258,14 @@ def main():
     base = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5, far=20.0)
 
     def make_step(fold_name):
-        mc = SliceMarchConfig(fold=fold_name, chunk=chunk,
-                              occupancy_vtiles=vtiles)
+        from scenery_insitu_tpu.models.pipelines import \
+            resolve_occupancy_cfg
+
+        # the SAME resolver the pipeline applies, so the reported march
+        # config cannot drift from the march actually benched
+        mc = resolve_occupancy_cfg(
+            SliceMarchConfig(fold=fold_name, chunk=chunk,
+                             occupancy_vtiles=vtiles), skip_mode)
         return mc, grayscott_vdi_frame_step(
             width, height, sim_steps=sim_steps, max_steps=steps,
             vdi_cfg=VDIConfig(max_supersegments=k, adaptive_iters=ad_iters,
@@ -259,7 +275,8 @@ def main():
                                      exchange=exchange, wire=wire),
             engine=engine, grid_shape=(grid, grid, grid),
             axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
-            slicer_cfg=mc, render_dtype=render_dtype, sim_fused=sim_fused)
+            slicer_cfg=mc, render_dtype=render_dtype, sim_fused=sim_fused,
+            occupancy=skip_mode)
 
     # the mxu step is compiled for the base camera's march regime (axis z
     # here); oscillate the orbit within ±0.35 rad so every benched frame
@@ -402,7 +419,8 @@ def main():
         spec = slicer.make_spec(base, (grid, grid, grid), march_cfg)
         render_cfg = {"image": [spec.ni, spec.nj], "steps": grid,
                       "fold": spec.fold, "render_dtype": render_dtype,
-                      "vtiles": vtiles}
+                      "vtiles": spec.vtiles,
+                      "skip_empty": spec.skip_empty}
         res_tag = f"{spec.ni}x{spec.nj}"
         marches = (1 if temporal else
                    2 if ad_mode == "histogram" else ad_iters + 1)
@@ -430,6 +448,36 @@ def main():
         hbm_src = "min_traffic_model"
     hbm_gbps = hbm_bytes / dt / 1e9 if hbm_bytes else None
     peak_bw = _peak_hbm(dev.device_kind, platform)
+    # occupancy of the FINAL benched field (post-timing, host-side): the
+    # artifact records how sparse the measured scene actually was — the
+    # live fraction is what decides whether skip modes can pay, and the
+    # per-chunk histogram says whether the sparsity is banded or diffuse
+    occupancy_info = None
+    if engine == "mxu":
+        try:
+            import numpy as _np
+
+            from scenery_insitu_tpu.core.transfer import for_dataset
+            from scenery_insitu_tpu.core.volume import Volume
+            from scenery_insitu_tpu.ops import occupancy as occ_mod
+
+            fld = (v.astype(jnp.bfloat16)
+                   if render_dtype == "bf16" else v)
+            pyr = occ_mod.pyramid_from_volume(
+                Volume.centered(fld, extent=2.0),
+                for_dataset("gray_scott"), spec)
+            clf = _np.asarray(pyr.chunk_live_fractions())
+            occupancy_info = {
+                "mode": skip_mode or ("pyramid" if spec.vtiles > 0 else
+                                      "chunk" if spec.skip_empty else
+                                      "off"),
+                "vtiles": spec.vtiles,
+                "live_fraction": round(float(pyr.live_fraction()), 4),
+                "chunk_live_hist": _np.histogram(
+                    clf, bins=8, range=(0.0, 1.0))[0].tolist(),
+            }
+        except Exception as e:   # never let reporting kill the artifact
+            occupancy_info = {"error": f"{type(e).__name__}: {e}"}
     # CONFIG-MATCHED vs_baseline: fps/30 only at the 512^3 primary scale
     # on the flagship engine, null otherwise — the mxu render work scales
     # ~grid^4 and the sim ~grid^3, so no single exponent converts a
@@ -477,11 +525,12 @@ def main():
         # runs have no exchange; composite_bench measures the real one)
         "modeled_exchange_8rank": _mod_exchange(
             8, k, height, width, exchange, wire),
+        "occupancy": occupancy_info,
         "degradations": obs.ledger(),
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "sim_fused": sim_fused, "exchange": exchange,
-                   "wire": wire,
+                   "wire": wire, "skip": skip_mode,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
                    "chunk": chunk, "scan_frames": bool(scan_frames),
                    "autotune_ms": autotune_ms,

@@ -1,0 +1,256 @@
+"""Empty-space-skipping A/B: off / chunk / pyramid / sim (ISSUE 6).
+
+Two halves, one artifact:
+
+1. **Measured** — time the full VDI generation (histogram-adaptive, the
+   two-march shape) at ``--grid`` on the CURRENT backend for each skip
+   mode, with the XLA cost-analysis bytes of every compiled step and a
+   skip-on vs skip-off parity check (the march's skip path is exact, so
+   max|diff| ~ fp noise; the bit-exact composite parity lives in
+   tests/test_occupancy.py). On CPU the measured grid defaults small —
+   the CPU timings say nothing about the TPU march and are labeled so;
+   run on hardware for the ms/frame deltas that matter.
+
+2. **Modeled** — build the REAL occupancy pyramid of the sparse
+   Gray-Scott scene at ``--model-grid`` (default 512, the flagship
+   scale: the canonical seed-cube init advanced ``--model-sim-steps``
+   steps) and convert its live fractions into per-march volume-read
+   bytes per mode:
+
+     off      every chunk's slices are read:  S_pad x Nv x Nu x itemsize
+     chunk    only live chunks are read       (exactly what slice_march's
+              lax.cond skip does — skipped chunks' dynamic_slice never
+              executes)
+     pyramid  only live (chunk x v-tile) cells are read — IDEALIZED for
+              the in-plane level: the banded-matmul gate skips the
+              resampling matmuls + TF of gated output-row blocks, and
+              this model charges volume reads proportionally (the
+              block's slice reads fuse away with every consumer gated);
+              treat the pyramid row as the structure's ceiling, the
+              chunk row as its floor. The same accounting the reference
+              wins with per-cell (VDIGenerator.comp:232-254).
+
+   The occupancy pass itself is charged as one extra volume read for
+   the volume-built modes and ~zero for sim-fused ranges (the stencil
+   epilogue rides the sim's own pass — sim/pallas_stencil.py).
+
+Writes one JSON artifact (--out); the driver's acceptance gate reads
+``model["reduction_vs_off"]["pyramid"]`` (>= 2x on the sparse 512^3
+scene). SITPU_CPU=1 pins the CPU backend.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", type=int, default=0,
+                    help="measured grid (0 = 512 on TPU, 48 on CPU)")
+    ap.add_argument("--model-grid", type=int, default=512)
+    ap.add_argument("--model-sim-steps", type=int, default=10,
+                    help="Gray-Scott steps developing the model scene")
+    ap.add_argument("--sim-steps", type=int, default=5,
+                    help="sim steps per measured frame (timed separately)")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--vtiles", type=int, default=16)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    if os.environ.get("SITPU_CPU") == "1":
+        from scenery_insitu_tpu.utils.backend import pin_cpu_backend
+        pin_cpu_backend()
+    from scenery_insitu_tpu.utils.backend import enable_compile_cache
+    enable_compile_cache()
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scenery_insitu_tpu import obs
+    from scenery_insitu_tpu.config import SliceMarchConfig, VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.core.volume import Volume
+    from scenery_insitu_tpu.obs.device import cost_snapshot
+    from scenery_insitu_tpu.ops import occupancy as occ_mod
+    from scenery_insitu_tpu.ops import slicer
+    from scenery_insitu_tpu.sim import grayscott as gs
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    grid = args.grid or (512 if on_tpu else 48)
+    tf = for_dataset("gray_scott")
+    cam = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5,
+                        far=20.0)
+    print(f"[occupancy_bench] backend={dev.platform} measured grid={grid} "
+          f"model grid={args.model_grid}", file=sys.stderr, flush=True)
+
+    # ---------------------------------------------------------- measured
+    def spec_for(mode, shape):
+        mc = SliceMarchConfig(matmul_dtype="f32" if not on_tpu else "bf16",
+                              chunk=args.chunk)
+        if mode == "off":
+            mc = dataclasses.replace(mc, skip_empty=False,
+                                     occupancy_vtiles=0)
+        elif mode == "chunk":
+            mc = dataclasses.replace(mc, skip_empty=True,
+                                     occupancy_vtiles=0)
+        else:
+            mc = dataclasses.replace(mc, skip_empty=True,
+                                     occupancy_vtiles=args.vtiles)
+        return slicer.make_spec(cam, shape, mc)
+
+    st = gs.GrayScott.init((grid, grid, grid))
+    st = gs.multi_step(st, 10)               # develop the benched scene
+    vdi_cfg = VDIConfig(max_supersegments=args.k, adaptive_iters=2,
+                        adaptive_mode="histogram")
+
+    # EVERY mode times the same unit of work — one in-situ frame: sim
+    # advance + occupancy derivation (whatever the mode's source is) +
+    # generation. All frames advance from the SAME (u, v), so the
+    # rendered field is identical across modes and the parity check
+    # below compares like with like.
+    measured = {}
+    outs = {}
+    for mode in ("off", "chunk", "pyramid", "sim"):
+        spec = spec_for(mode, st.v.shape)
+
+        if mode == "sim":
+            # the pyramid rides the sim advance (fused epilogue on TPU,
+            # ledgered lax fallback elsewhere)
+            def frame(u, v, spec=spec):
+                st2, rng = gs.multi_step_fast_ranges(
+                    gs.GrayScott(u, v, st.params), args.sim_steps)
+                vol2 = Volume.centered(st2.field, extent=2.0)
+                pyr = occ_mod.pyramid_from_ranges(rng, vol2, tf, spec)
+                vdi, _, _ = slicer.generate_vdi_mxu(
+                    vol2, tf, cam, spec, vdi_cfg, occupancy=pyr)
+                return vdi.color, vdi.depth
+        else:
+            def frame(u, v, spec=spec):
+                st2 = gs.multi_step_fast(
+                    gs.GrayScott(u, v, st.params), args.sim_steps)
+                vdi, _, _ = slicer.generate_vdi_mxu(
+                    Volume.centered(st2.field, extent=2.0), tf, cam,
+                    spec, vdi_cfg)
+                return vdi.color, vdi.depth
+        f = jax.jit(frame)
+        fargs = (st.u, st.v)
+
+        try:
+            out = f(*fargs)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = f(*fargs)
+            jax.block_until_ready(out)
+            ms = (time.perf_counter() - t0) / args.iters * 1e3
+            outs[mode] = tuple(np.asarray(o) for o in out)
+            snap = cost_snapshot(f, *fargs) or {}
+            measured[mode] = {
+                "ms_per_frame": round(ms, 2),
+                "vtiles": spec.vtiles,
+                "cost_bytes": snap.get("bytes_accessed"),
+                "cost_source": snap.get("source"),
+            }
+        except Exception as e:
+            measured[mode] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"[occupancy_bench] measured {mode}: "
+              f"{measured[mode]}", file=sys.stderr, flush=True)
+
+    parity = None
+    if "off" in outs:
+        ref_c, ref_d = outs["off"]
+        parity = {}
+        for mode in ("chunk", "pyramid"):
+            if mode not in outs:
+                continue
+            dc = float(np.abs(outs[mode][0] - ref_c).max())
+            dd = float(np.abs(np.nan_to_num(outs[mode][1], posinf=1e9)
+                              - np.nan_to_num(ref_d, posinf=1e9)).max())
+            parity[mode] = {"max_abs_diff_color": dc,
+                            "max_abs_diff_depth": dd}
+
+    # ----------------------------------------------------------- modeled
+    mg = args.model_grid
+    print(f"[occupancy_bench] building {mg}^3 model scene "
+          f"({args.model_sim_steps} steps)...", file=sys.stderr, flush=True)
+    stm = gs.GrayScott.init((mg, mg, mg))
+    if args.model_sim_steps:
+        stm = gs.multi_step(stm, args.model_sim_steps)
+    mvol = Volume.centered(stm.field, extent=2.0)
+    mspec = spec_for("pyramid", mvol.data.shape)
+    pyr = occ_mod.pyramid_from_volume(mvol, tf, mspec)
+    chunks = np.asarray(pyr.chunks)
+    tiles = np.asarray(pyr.tiles)
+    live_chunks = float(chunks.mean())
+    live_cells = float(tiles.mean())
+
+    itemsize = 4.0          # the model scene marches f32 (render_dtype)
+    vol_read = float(mg) ** 3 * itemsize          # one full march's reads
+    occupancy_pass = vol_read                     # one reduction sweep
+    march_bytes = {
+        "off": vol_read,
+        "chunk": live_chunks * vol_read + occupancy_pass / _marches(),
+        "pyramid": live_cells * vol_read + occupancy_pass / _marches(),
+        "sim": live_cells * vol_read,   # ranges ride the sim kernel
+    }
+    model = {
+        "grid": mg,
+        "sim_steps": args.model_sim_steps,
+        "chunk": args.chunk, "vtiles": int(tiles.shape[1]),
+        "nchunks": int(chunks.size),
+        "live_chunk_fraction": round(live_chunks, 4),
+        "live_cell_fraction": round(live_cells, 4),
+        "chunk_live_hist": np.histogram(
+            tiles.mean(axis=1), bins=8, range=(0.0, 1.0))[0].tolist(),
+        "march_read_bytes": {k2: round(v2) for k2, v2
+                             in march_bytes.items()},
+        "reduction_vs_off": {
+            k2: round(march_bytes["off"] / v2, 2)
+            for k2, v2 in march_bytes.items() if k2 != "off"},
+        "assumptions": (
+            "volume-read bytes per march; chunk row is exact "
+            "(skipped chunks' dynamic_slice never executes), pyramid/sim "
+            "rows idealize the in-plane gate to proportional reads (its "
+            "matmul+TF skip is exact, the slice-read saving needs every "
+            "consumer of a block gated); occupancy build charged as one "
+            "volume sweep amortized over the frame's marches for "
+            "volume-built modes, ~0 for sim-fused ranges"),
+    }
+
+    out = {
+        "metric": f"occupancy_ab_{grid}c_{dev.platform}",
+        "platform": dev.platform, "device": dev.device_kind,
+        "measured": {"grid": grid, "iters": args.iters,
+                     "k": args.k, "modes": measured, "parity": parity},
+        "model": model,
+        "degradations": obs.ledger(),
+    }
+    line = json.dumps(out)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as fo:
+            fo.write(line + "\n")
+        print(f"[occupancy_bench] wrote {args.out}", file=sys.stderr,
+              flush=True)
+
+
+def _marches() -> float:
+    """Marches per frame the occupancy pass amortizes over (histogram
+    mode: one counting + one writing)."""
+    return 2.0
+
+
+if __name__ == "__main__":
+    main()

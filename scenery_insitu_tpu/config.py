@@ -19,6 +19,14 @@ from typing import Any, Optional, Tuple
 
 ENV_PREFIX = "SITPU_"
 
+# The benched in-plane occupancy tile count (docs/PERF.md "Empty-space
+# skipping") — the ONE place the default lives: slicer.make_spec's auto
+# resolution (occupancy_vtiles == -1 on TPU),
+# models.pipelines.resolve_occupancy_cfg's pyramid/sim modes, and
+# occupancy.default_bricks' y-brick cap all read it, so re-benching the
+# default can never leave the sites disagreeing.
+OCCUPANCY_VTILES_DEFAULT = 16
+
 
 @dataclass(frozen=True)
 class RenderConfig:
@@ -116,7 +124,13 @@ class SliceMarchConfig:
     # resampling matmuls + TF for output row blocks whose support is
     # provably empty (see slicer.AxisSpec.vtiles). Adds N lax.cond
     # branches per chunk — worth it on sparse fields, overhead on dense.
-    occupancy_vtiles: int = 0
+    # -1 (the default) resolves per backend in slicer.make_spec: 16 on
+    # TPU (the benched winner on sparse Gray-Scott — see
+    # benchmarks/occupancy_bench.py and docs/PERF.md "Empty-space
+    # skipping"), 0 elsewhere (the branches are pure overhead on CPU).
+    # A request larger than the geometry supports is clamped and the
+    # reduction recorded on the fallback ledger (occupancy.vtiles_clamp).
+    occupancy_vtiles: int = -1
     # Supersegment-fold schedule for the VDI marches:
     #   "xla"        sequential ss.push machine in a lax.scan (every slice
     #                round-trips the [K] state through HBM — the portable
@@ -191,6 +205,21 @@ class CompositeConfig:
     # itself always runs in f32. Quantized modes are lossy by contract
     # (tests hold them to PSNR floors).
     wire: str = "f32"
+    # Per-rank supersegment budget of the sort-last fold (docs/PERF.md
+    # "Empty-space skipping"):
+    #   "static"     every rank's adaptive threshold targets the full K
+    #                (the pre-ISSUE-6 behavior, bit-exact);
+    #   "occupancy"  rank r targets its share of the mesh-wide budget
+    #                N*K, proportional to its occupancy-pyramid live
+    #                fraction and clamped to [k_budget_min, K]
+    #                (ops/occupancy.k_budget_target). Array SHAPES stay
+    #                at K on every rank (one SPMD program): sparse slabs
+    #                emit coarser VDIs whose unused slots stay +inf
+    #                (near-free on a quantized wire), dense slabs keep
+    #                full fidelity — a quality/work re-balance, not a
+    #                memory one.
+    k_budget: str = "static"
+    k_budget_min: int = 4      # floor of the occupancy budget, slots
 
     def __post_init__(self):
         if self.exchange not in ("all_to_all", "ring"):
@@ -202,6 +231,12 @@ class CompositeConfig:
         if self.wire not in ("f32", "bf16", "qpack8"):
             raise ValueError(f"wire must be 'f32', 'bf16' or 'qpack8', "
                              f"got {self.wire!r}")
+        if self.k_budget not in ("static", "occupancy"):
+            raise ValueError(f"k_budget must be 'static' or 'occupancy', "
+                             f"got {self.k_budget!r}")
+        if self.k_budget_min < 1:
+            raise ValueError(f"k_budget_min must be >= 1, "
+                             f"got {self.k_budget_min}")
 
 
 @dataclass(frozen=True)

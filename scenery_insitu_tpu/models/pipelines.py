@@ -21,6 +21,33 @@ from scenery_insitu_tpu.sim import grayscott as gs
 from scenery_insitu_tpu.sim import particles as pt
 
 
+def resolve_occupancy_cfg(slicer_cfg, occupancy: Optional[str]):
+    """Normalize an empty-space-skipping mode name onto a
+    SliceMarchConfig (docs/PERF.md "Empty-space skipping"). ONE resolver
+    shared by `grayscott_vdi_frame_step` and bench.py's SITPU_BENCH_SKIP
+    reporting, so the artifact's recorded march config can never drift
+    from the march actually benched. ``None`` keeps the config as-is."""
+    import dataclasses
+
+    if occupancy is None:
+        return slicer_cfg
+    if occupancy not in ("off", "chunk", "pyramid", "sim"):
+        raise ValueError(f"occupancy must be 'off', 'chunk', 'pyramid' "
+                         f"or 'sim', got {occupancy!r}")
+    if occupancy == "off":
+        return dataclasses.replace(slicer_cfg, skip_empty=False)
+    if occupancy == "chunk":
+        return dataclasses.replace(slicer_cfg, skip_empty=True,
+                                   occupancy_vtiles=0)
+    # pyramid / sim want the in-plane level on
+    from scenery_insitu_tpu.config import OCCUPANCY_VTILES_DEFAULT
+
+    vt = slicer_cfg.occupancy_vtiles
+    return dataclasses.replace(
+        slicer_cfg, skip_empty=True,
+        occupancy_vtiles=(OCCUPANCY_VTILES_DEFAULT if vt <= 0 else vt))
+
+
 def grayscott_vdi_frame_step(width: int, height: int,
                              sim_steps: int = 5, max_steps: int = 96,
                              vdi_cfg: Optional[VDIConfig] = None,
@@ -32,7 +59,8 @@ def grayscott_vdi_frame_step(width: int, height: int,
                              grid_shape=None, axis_sign=None,
                              slicer_cfg=None,
                              render_dtype: Optional[str] = None,
-                             sim_fused: bool = True):
+                             sim_fused: bool = True,
+                             occupancy: Optional[str] = None):
     """Single-chip in-situ frame step: Gray-Scott advance → VDI generation
     → composite. Returns ``fn(u, v, eye) -> (color, depth, u, v)``
     (jittable; the flagship single-device hot path).
@@ -42,6 +70,19 @@ def grayscott_vdi_frame_step(width: int, height: int,
     f32; see SliceMarchConfig.render_dtype). ``sim_fused=False`` pins the
     sim advance to the XLA roll formulation instead of the time-fused
     Pallas stencil — the sim-fusion lever's A/B switch.
+
+    ``occupancy`` picks the empty-space-skipping mode of the A/B ladder
+    (benchmarks/occupancy_bench.py; docs/PERF.md "Empty-space
+    skipping"); None keeps whatever ``slicer_cfg`` says:
+      "off"      no skipping (the baseline);
+      "chunk"    whole-chunk skipping only (vtiles=0);
+      "pyramid"  chunk × in-plane-tile pyramid rebuilt from the volume
+                 each frame (vtiles stays as configured, defaulting 16);
+      "sim"      the pyramid is built from per-brick field ranges that
+                 ride out of the sim advance itself
+                 (grayscott.multi_step_fast_ranges →
+                 occupancy.pyramid_from_ranges) — conservative, zero
+                 extra volume traffic; mxu-only.
 
     engine="mxu" uses the slice-march raycaster (ops/slicer.py; requires
     the static ``grid_shape`` AND ``axis_sign`` — the march regime, from
@@ -70,6 +111,11 @@ def grayscott_vdi_frame_step(width: int, height: int,
     params = params or gs.GrayScottParams.create()
     engine = slicer.resolve_engine(engine)
     slicer_cfg = slicer_cfg or SliceMarchConfig()
+    sim_occ = occupancy == "sim"
+    slicer_cfg = resolve_occupancy_cfg(slicer_cfg, occupancy)
+    if sim_occ and engine != "mxu":
+        raise ValueError("occupancy='sim' feeds the slice march's "
+                         "pyramid; it needs engine='mxu'")
     if render_dtype is None:
         render_dtype = slicer_cfg.render_dtype
     else:
@@ -111,15 +157,29 @@ def grayscott_vdi_frame_step(width: int, height: int,
                 "temporal mode carries threshold state: call as "
                 "frame_step(u, v, eye, thr), seeding thr with "
                 "frame_step.init_threshold(u, v, eye)")
-        state = advance(gs.GrayScott(u, v, params), sim_steps)
+        if sim_occ:
+            # the occupancy structure rides out of the sim advance
+            # (fused-kernel epilogue, lax fallback ledgered) — the
+            # render below never re-reads the volume for it
+            state, rng = gs.multi_step_fast_ranges(
+                gs.GrayScott(u, v, params), sim_steps, fused=sim_fused)
+        else:
+            state = advance(gs.GrayScott(u, v, params), sim_steps)
         field = state.field if rdt is None else state.field.astype(rdt)
         vol = Volume.centered(field, extent=2.0)
+        occ_pyr = None
+        if sim_occ:
+            from scenery_insitu_tpu.ops import occupancy as occ_mod
+
+            occ_pyr = occ_mod.pyramid_from_ranges(rng, vol, tf, spec)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
         if temporal:
             vdi, _, _, thr = slicer.generate_vdi_mxu_temporal(
-                vol, tf, cam, spec, thr, vdi_cfg)
+                vol, tf, cam, spec, thr, vdi_cfg, occupancy=occ_pyr)
         elif engine == "mxu":
-            vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec, vdi_cfg)
+            vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec,
+                                                vdi_cfg,
+                                                occupancy=occ_pyr)
         else:
             vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
                                   max_steps=max_steps)

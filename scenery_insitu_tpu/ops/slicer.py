@@ -193,13 +193,36 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
         raise ValueError(f"unknown fold schedule {fold!r} (expected 'auto', "
                          "'xla', 'pallas', 'seg', 'pallas_seg', "
                          "'pallas_fused' or 'fused_stream')")
+    # resolve the benched auto default (-1): in-plane tiling pays on the
+    # TPU march (the A/B in benchmarks/occupancy_bench.py — sparse
+    # fields skip most cells) but adds nt lax.cond branches per chunk,
+    # pure overhead for the CPU/test path, which keeps chunk-only
+    # skipping unless a tile count is configured explicitly
+    vt = cfg.occupancy_vtiles
+    if vt < 0:
+        from scenery_insitu_tpu.config import OCCUPANCY_VTILES_DEFAULT
+
+        vt = (OCCUPANCY_VTILES_DEFAULT
+              if jax.default_backend() == "tpu" else 0)
     # clamp the tile count to what the geometry supports: each band needs
     # >= 2 volume rows (the apron + a zero-size reduction guard) and each
     # output block >= 2 rows — a too-large request degrades to coarser
-    # tiles instead of an obscure trace-time error
-    vt = cfg.occupancy_vtiles
+    # tiles instead of an obscure trace-time error, and the degradation
+    # goes on the fallback ledger (it silently coarsens skip granularity;
+    # distributed slabs re-clamp again in occupancy.resolved_tiles)
     if vt:
+        vt_req = vt
         vt = max(1, min(vt, dims_xyz[v_axis] // 2, nj // 2))
+        # ledger only EXPLICITLY configured counts the geometry cannot
+        # honor — the auto default clamping on a small grid is the
+        # default adapting, not a configuration silently ignored
+        if vt < vt_req and cfg.occupancy_vtiles > 0:
+            from scenery_insitu_tpu import obs
+
+            obs.degrade("occupancy.vtiles_clamp", str(vt_req), str(vt),
+                        f"volume v extent {dims_xyz[v_axis]} / grid nj "
+                        f"{nj} support at most {vt} bands of >= 2 rows",
+                        warn=False)
     return AxisSpec(axis=axis, sign=sign, ni=ni, nj=nj,
                     chunk=cfg.chunk, matmul_dtype=dtype,
                     s_floor=cfg.s_floor, skip_empty=cfg.skip_empty,
@@ -374,7 +397,8 @@ def _interp_matrix(pos: jnp.ndarray, origin, spacing, n: int,
 
 
 def chunk_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
-                    alpha_eps: float = 1e-5) -> jnp.ndarray:
+                    alpha_eps: float = 1e-5,
+                    volp: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """bool[nchunks]: can the slab of ``spec.chunk`` slices contribute any
     opacity? The TPU-native occupancy structure (≅ the reference's
     OctreeCells grid, VDIGenerator.comp:232-254 + GridCellsToZero.comp —
@@ -382,18 +406,15 @@ def chunk_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
     atomic-add during the march, and consumed by `slice_march` to skip
     whole chunks). Conservative: in-plane bilinear resampling keeps values
     inside each slice's [min, max], so a slab whose value range maps to
-    zero alpha everywhere (``tf.max_alpha_in``) is provably invisible."""
-    volp = permute_volume(vol, spec)
-    volp, nchunks = _pad_to_chunks(volp, spec.chunk)
-    if vol.data.ndim == 4:
-        # pre-shaded RGBA: a slab is visible iff any stored alpha is
-        alpha = volp[:, 3]
-        return alpha.reshape(nchunks, -1).max(axis=1) > alpha_eps
-    slabs = volp.reshape(nchunks, -1)
-    # reduce in storage dtype, evaluate the TF in f32 (bf16 march copies)
-    lo = jnp.clip(jnp.min(slabs, axis=1).astype(jnp.float32), 0.0, 1.0)
-    hi = jnp.clip(jnp.max(slabs, axis=1).astype(jnp.float32), 0.0, 1.0)
-    return tf.max_alpha_in(lo, hi) > alpha_eps
+    zero alpha everywhere (``tf.max_alpha_in``) is provably invisible.
+
+    Since ISSUE 6 this (and the vtile refinement below) is the nt=1
+    level of the shared occupancy pyramid — ops/occupancy.py owns the
+    band-range machinery; ``volp`` shares one permuted copy per frame."""
+    from scenery_insitu_tpu.ops import occupancy as _occ
+
+    return _occ.pyramid_from_volume(vol, tf, spec, volp=volp,
+                                    alpha_eps=alpha_eps, ntiles=1).chunks
 
 
 def _pad_to_chunks(volp: jnp.ndarray, c: int) -> Tuple[jnp.ndarray, int]:
@@ -410,7 +431,8 @@ def _pad_to_chunks(volp: jnp.ndarray, c: int) -> Tuple[jnp.ndarray, int]:
 
 
 def chunk_occupancy_vtiles(vol: Volume, tf: TransferFunction,
-                           spec: AxisSpec, alpha_eps: float = 1e-5
+                           spec: AxisSpec, alpha_eps: float = 1e-5,
+                           volp: Optional[jnp.ndarray] = None
                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(bool[nchunks], bool[nchunks, vtiles]): chunk- and
     (chunk x v-row-band)-granular occupancy in ONE pass over the volume —
@@ -427,48 +449,23 @@ def chunk_occupancy_vtiles(vol: Volume, tf: TransferFunction,
     apron-less band sees. The apron makes every adjacent-row pair fully
     contained in at least one band, restoring the conservative argument.
     Tiles split the VOLUME's v axis; the last band absorbs the remainder.
-    """
-    volp = permute_volume(vol, spec)                       # [S, Nv, Nu]
-    pre_shaded = vol.data.ndim == 4
-    if pre_shaded:
-        volp = volp[:, 3]                                  # alpha plane
-    volp, nchunks = _pad_to_chunks(volp, spec.chunk)
-    nv = volp.shape[1]
-    # re-clamp against THIS volume's v extent: make_spec clamped against
-    # the global shape, but distributed ranks march slabs whose sharded
-    # axis can be far smaller — nv // nt must stay >= 2 (tv = 0 would
-    # poison the gate's tile arithmetic). Consumers read the tile count
-    # from the array's shape, so the clamp propagates automatically.
-    nt = max(1, min(spec.vtiles, nv // 2))
-    tv = nv // nt
-    occ, los, his = [], [], []
-    for t in range(nt):
-        lo_r = max(t * tv - 1, 0)                          # apron row
-        hi_r = nv if t == nt - 1 else min((t + 1) * tv + 1, nv)
-        band = volp[:, lo_r:hi_r].reshape(nchunks, -1)
-        if pre_shaded:
-            occ.append(band.max(axis=1) > alpha_eps)
-        else:
-            lo = jnp.clip(jnp.min(band, axis=1).astype(jnp.float32),
-                          0.0, 1.0)
-            hi = jnp.clip(jnp.max(band, axis=1).astype(jnp.float32),
-                          0.0, 1.0)
-            occ.append(tf.max_alpha_in(lo, hi) > alpha_eps)
-            los.append(lo)
-            his.append(hi)
-    tiles = jnp.stack(occ, axis=1)                         # [nchunks, nt]
-    if pre_shaded:
-        chunks = jnp.any(tiles, axis=1)
-    else:
-        # whole-slab range = union of the band ranges (aprons only widen
-        # within the slab), so this equals chunk_occupancy exactly
-        chunks = tf.max_alpha_in(jnp.min(jnp.stack(los), axis=0),
-                                 jnp.max(jnp.stack(his), axis=0)) > alpha_eps
-    return chunks, tiles
+
+    The tile count re-clamps against THIS volume's v extent
+    (occupancy.resolved_tiles — distributed ranks march slabs far
+    smaller than the global shape `make_spec` clamped against; the
+    reduction lands on the fallback ledger). Consumers read the count
+    from the array's shape, so the clamp propagates automatically.
+    Implementation lives in ops/occupancy.py (the shared pyramid)."""
+    from scenery_insitu_tpu.ops import occupancy as _occ
+
+    pyr = _occ.pyramid_from_volume(vol, tf, spec, volp=volp,
+                                   alpha_eps=alpha_eps)
+    return pyr.chunks, pyr.tiles
 
 
 def _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
-                     u_bounds, v_bounds, step_scale: float = 1.0):
+                     u_bounds, v_bounds, step_scale: float = 1.0,
+                     volp=None):
     """One write march through the fused shade+fold kernel (raw mode).
     The length/ds/ratio geometry matches slice_march's own shading
     formula INCLUDING step_scale — one implementation for both the plain
@@ -484,12 +481,13 @@ def _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
     packed = slice_march(vol, tf, axcam, spec, consume,
                          psg.init_seg_packed(k, spec.nj, spec.ni),
                          u_bounds, v_bounds, step_scale=step_scale,
-                         occupancy=occ, raw=True)
+                         occupancy=occ, raw=True, volp=volp)
     return psg.unpack_seg_state(packed)
 
 
 def _fused_stream_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
-                            u_bounds, v_bounds, step_scale: float = 1.0):
+                            u_bounds, v_bounds, step_scale: float = 1.0,
+                            volp=None):
     """Two-phase whole-march fused fold: phase M materializes the raw
     value stream (the matmul phase, chunk-skipping intact — skipped
     chunks write -1 planes), then ONE pallas_call folds the entire
@@ -518,22 +516,44 @@ def _fused_stream_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
     buf, skb, _ = slice_march(vol, tf, axcam, spec, consume,
                               (buf0, sk0, jnp.int32(0)), u_bounds,
                               v_bounds, step_scale=step_scale,
-                              occupancy=occ, raw=True, raw_full_skip=True)
+                              occupancy=occ, raw=True, raw_full_skip=True,
+                              volp=volp)
     packed = psg.fused_stream_fold(
         psg.init_seg_packed(k, spec.nj, spec.ni), buf, length, ratio,
         skb, skb + ds, threshold, max_k=k, chunk=c, tf=tf)
     return psg.unpack_seg_state(packed)
 
 
-def occupancy_for(vol: Volume, tf: TransferFunction, spec: AxisSpec):
+def occupancy_for(vol: Volume, tf: TransferFunction, spec: AxisSpec,
+                  volp: Optional[jnp.ndarray] = None):
     """The occupancy structure `slice_march` consumes for this spec:
     None (skipping off), bool[nchunks], or (chunk, tile) tuple when
-    ``spec.vtiles > 0``."""
+    ``spec.vtiles > 0`` — one occupancy-pyramid build
+    (ops/occupancy.pyramid_from_volume), gated down to the march's
+    contract. ``volp`` shares the frame's permuted volume copy."""
     if not spec.skip_empty:
         return None
-    if spec.vtiles > 0:
-        return chunk_occupancy_vtiles(vol, tf, spec)
-    return chunk_occupancy(vol, tf, spec)
+    from scenery_insitu_tpu.ops import occupancy as _occ
+
+    return _occ.pyramid_from_volume(vol, tf, spec, volp=volp).gate(spec)
+
+
+def _resolve_occupancy(vol: Volume, tf: TransferFunction, spec: AxisSpec,
+                       occupancy, volp: Optional[jnp.ndarray]):
+    """Normalize a caller-provided occupancy (an ops/occupancy
+    OccupancyPyramid — built once per frame, possibly from sim-fused
+    field ranges — or the legacy gate arrays) to the `slice_march`
+    contract; None builds the per-call pyramid like the pre-ISSUE-6
+    path did. Skipping off always wins."""
+    if not spec.skip_empty:
+        return None
+    if occupancy is None:
+        return occupancy_for(vol, tf, spec, volp=volp)
+    from scenery_insitu_tpu.ops import occupancy as _occ
+
+    if isinstance(occupancy, _occ.OccupancyPyramid):
+        return occupancy.gate(spec)
+    return occupancy
 
 
 def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
@@ -542,7 +562,8 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 occupancy: Optional[jnp.ndarray] = None,
                 early_stop: Optional[Callable] = None, raw: bool = False,
                 raw_full_skip: bool = False,
-                shaded_compact: bool = False):
+                shaded_compact: bool = False,
+                volp: Optional[jnp.ndarray] = None):
     """The chunked slice march. Calls ``consume(carry, rgba [C,4,Nj,Ni],
     t0 [C,Nj,Ni], t1 [C,Nj,Ni]) -> carry`` for each chunk of slices, front
     to back, and returns the final carry.
@@ -591,10 +612,21 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     occ_tiles = None
     if isinstance(occupancy, tuple):
         occupancy, occ_tiles = occupancy
-    volp0 = permute_volume(vol, spec)
+    # ``volp`` shares the frame's one permuted copy (occupancy pass +
+    # every march of the frame read the same layout; XLA CSEs the
+    # transpose either way inside one jit, but the explicit handoff also
+    # serves eager callers and keeps the structure visible)
+    volp0 = permute_volume(vol, spec) if volp is None else volp
     s_total = volp0.shape[0]
     c = spec.chunk
     volp, nchunks = _pad_to_chunks(volp0, c)
+    if occupancy is not None and occupancy.shape[0] != nchunks:
+        # both sides chunk through the shared _pad_to_chunks, so a
+        # mismatch means the occupancy was built for a DIFFERENT volume
+        # or chunk size — skipping with it would be silently wrong
+        raise ValueError(
+            f"occupancy describes {occupancy.shape[0]} chunks but this "
+            f"march has {nchunks} (volume {vol.data.shape}, chunk {c})")
 
     ou, su, nu, ov, sv, nv = _axis_params(vol, spec)
     eu, ev, ew = axcam.eye_u, axcam.eye_v, axcam.eye_w
@@ -813,7 +845,8 @@ def hittable_mask(vol: Volume, axcam: AxisCamera, spec: AxisSpec
 def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                   spec: AxisSpec, early_exit_alpha: float = 0.999,
                   u_bounds=None, v_bounds=None,
-                  step_scale: float = 1.0) -> RaycastOutput:
+                  step_scale: float = 1.0,
+                  occupancy=None) -> RaycastOutput:
     """Front-to-back alpha-under accumulation on the intermediate grid
     (≅ VolumeRaycaster.comp, but slice-order). Background-free premultiplied
     image + first-hit depth (ray parameter; +inf where empty). Skips
@@ -853,9 +886,11 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 
     acc0 = jnp.zeros((4, spec.nj, spec.ni), jnp.float32)
     t0 = jnp.full((spec.nj, spec.ni), jnp.inf, jnp.float32)
-    occ = occupancy_for(vol, tf, spec)
+    volp = permute_volume(vol, spec)
+    occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     acc, first_t = slice_march(vol, tf, axcam, spec, consume, (acc0, t0),
-                               u_bounds, v_bounds, step_scale, occupancy=occ)
+                               u_bounds, v_bounds, step_scale,
+                               occupancy=occ, volp=volp)
     return RaycastOutput(acc, first_t)
 
 
@@ -952,6 +987,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
                      box_min: Optional[jnp.ndarray] = None,
                      box_max: Optional[jnp.ndarray] = None,
                      u_bounds=None, v_bounds=None,
+                     occupancy=None, k_target=None,
                      ) -> Tuple[VDI, VDIMetadata, AxisCamera]:
     """VDI generation on the MXU slice march (≅ VDIGenerator.comp +
     AccumulateVDI.comp, see ops.vdi_gen for the gather-path equivalent).
@@ -960,17 +996,27 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     the virtual projection/view, so compositing, novel-view rendering and
     streaming treat it exactly like a gather-path VDI. Depths are the world
     ray parameter of the (virtual = real) eye.
-    """
+
+    ``occupancy``: a per-frame ops/occupancy.OccupancyPyramid (built once
+    and shared across every march of the frame — possibly from sim-fused
+    field ranges, costing no volume sweep at all) or a legacy gate; None
+    rebuilds from the volume here. ``k_target`` (traced scalar or
+    [nj, ni]) re-targets the adaptive threshold at fewer than
+    ``cfg.max_supersegments`` segments — output SHAPES stay at K; this is
+    the load-aware K budget hook (occupancy.k_budget_target)."""
     cfg = cfg or VDIConfig()
     k = cfg.max_supersegments
+    kt = k if k_target is None else k_target
     nj, ni = spec.nj, spec.ni
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
 
-    # one occupancy pass shared by every counting + writing march
-    occ = occupancy_for(vol, tf, spec)
+    # ONE permuted copy + one occupancy structure shared by every
+    # counting + writing march of this generation
+    volp = permute_volume(vol, spec)
+    occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
-        occupancy=occ)
+        occupancy=occ, volp=volp)
 
     if cfg.adaptive and cfg.adaptive_mode == "temporal":
         raise ValueError(
@@ -978,7 +1024,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
             "call generate_vdi_mxu_temporal(..., threshold=...) instead "
             "(seed the state with initial_threshold())")
     if cfg.adaptive and cfg.adaptive_mode == "histogram":
-        threshold = _histogram_threshold(march, cfg, k, nj, ni, spec.fold)
+        threshold = _histogram_threshold(march, cfg, kt, nj, ni, spec.fold)
     elif cfg.adaptive:
         # "search" mode: adaptive_iters counting marches (XLA fold — the
         # default modes are histogram/temporal; search stays the portable
@@ -989,7 +1035,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
                     st = ss.push_count(st, thr, rgba[i])
                 return st
             return march(consume, ss.init_count(nj, ni)).count
-        threshold = ss.adaptive_threshold(count_fn, k, cfg.adaptive_iters,
+        threshold = ss.adaptive_threshold(count_fn, kt, cfg.adaptive_iters,
                                           nj, ni)
     else:
         threshold = jnp.full((nj, ni), cfg.threshold, jnp.float32)
@@ -1016,7 +1062,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         packed = slice_march(vol, tf, axcam, spec, consume,
                              psg.init_seg_packed(k, nj, ni),
                              u_bounds, v_bounds, occupancy=occ,
-                             shaded_compact=True)
+                             shaded_compact=True, volp=volp)
         color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
     elif spec.fold in ("pallas_fused", "fused_stream"):
         # shade-in-kernel: the march feeds the raw resampled value plane
@@ -1028,7 +1074,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         marcher = (_fused_stream_vdi_march if spec.fold == "fused_stream"
                    else _fused_vdi_march)
         state = marcher(vol, tf, axcam, spec, threshold, k, occ,
-                        u_bounds, v_bounds)
+                        u_bounds, v_bounds, volp=volp)
         color, depth = sf.seg_finalize(state)
     elif spec.fold == "seg":
         def consume(st, rgba, t0, t1):
@@ -1091,19 +1137,23 @@ def initial_threshold(vol: Volume, tf: TransferFunction, cam: Camera,
                       spec: AxisSpec, cfg: Optional[VDIConfig] = None,
                       box_min: Optional[jnp.ndarray] = None,
                       box_max: Optional[jnp.ndarray] = None,
-                      u_bounds=None, v_bounds=None) -> ss.ThresholdState:
+                      u_bounds=None, v_bounds=None,
+                      occupancy=None, k_target=None) -> ss.ThresholdState:
     """Seed state for the temporal threshold controller ([nj, ni] maps):
     one histogram counting march on the current scene (the same pass
     adaptive_mode="histogram" runs every frame — temporal mode runs it
     once at session start, then `generate_vdi_mxu_temporal` keeps the map
-    in band for one-march frames)."""
+    in band for one-march frames). ``occupancy``/``k_target``: see
+    `generate_vdi_mxu`."""
     cfg = cfg or VDIConfig()
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
-    occ = occupancy_for(vol, tf, spec)
+    volp = permute_volume(vol, spec)
+    occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
-        occupancy=occ)
-    thr = _histogram_threshold(march, cfg, cfg.max_supersegments,
+        occupancy=occ, volp=volp)
+    kt = cfg.max_supersegments if k_target is None else k_target
+    thr = _histogram_threshold(march, cfg, kt,
                                spec.nj, spec.ni, spec.fold)
     return ss.init_threshold_state(thr, cfg.thr_min, cfg.thr_max)
 
@@ -1116,6 +1166,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
                               box_min: Optional[jnp.ndarray] = None,
                               box_max: Optional[jnp.ndarray] = None,
                               u_bounds=None, v_bounds=None,
+                              occupancy=None, k_target=None,
                               ) -> Tuple[VDI, VDIMetadata, AxisCamera,
                                          ss.ThresholdState]:
     """VDI generation with ONE march per frame (adaptive_mode="temporal").
@@ -1132,13 +1183,19 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
     drastically this frame is written with last frame's threshold (its
     overflow merges into the last slot — the same graceful degradation
     every mode shares) and corrected over the following frames.
+
+    ``occupancy``/``k_target``: see `generate_vdi_mxu` — the controller
+    bisects toward ``k_target`` (the occupancy K budget) instead of K
+    when given; output shapes stay at K.
     """
     cfg = cfg or VDIConfig()
     k = cfg.max_supersegments
+    kt = k if k_target is None else k_target
     nj, ni = spec.nj, spec.ni
     thr = threshold.thr
     axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
-    occ = occupancy_for(vol, tf, spec)
+    volp = permute_volume(vol, spec)
+    occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
 
     if spec.fold == "pallas":
         # fused write+count: ONE kernel per chunk, the count rides the
@@ -1152,7 +1209,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         packed, count = slice_march(
             vol, tf, axcam, spec, consume,
             (pm.init_packed(k, nj, ni), jnp.zeros((nj, ni), jnp.int32)),
-            u_bounds, v_bounds, occupancy=occ)
+            u_bounds, v_bounds, occupancy=occ, volp=volp)
         color, depth = ss.finalize(pm.unpack_state(packed))
     elif spec.fold in ("seg", "pallas_seg", "pallas_fused",
                        "fused_stream"):
@@ -1164,7 +1221,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
                        if spec.fold == "fused_stream"
                        else _fused_vdi_march)
             state = marcher(vol, tf, axcam, spec, thr, k, occ,
-                            u_bounds, v_bounds)
+                            u_bounds, v_bounds, volp=volp)
         elif spec.fold == "pallas_seg":
             length = axcam.ray_lengths()
 
@@ -1176,7 +1233,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
             packed = slice_march(vol, tf, axcam, spec, consume,
                                  psg.init_seg_packed(k, nj, ni),
                                  u_bounds, v_bounds, occupancy=occ,
-                                 shaded_compact=True)
+                                 shaded_compact=True, volp=volp)
             state = psg.unpack_seg_state(packed)
         else:
             def consume(st, rgba, t0, t1):
@@ -1184,7 +1241,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
 
             state = slice_march(vol, tf, axcam, spec, consume,
                                 sf.init_seg_state(k, nj, ni),
-                                u_bounds, v_bounds, occupancy=occ)
+                                u_bounds, v_bounds, occupancy=occ,
+                                volp=volp)
         color, depth = sf.seg_finalize(state)
         count = state.cnt
     else:
@@ -1198,10 +1256,10 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         state, cstate = slice_march(
             vol, tf, axcam, spec, consume,
             (ss.init_state(k, nj, ni), ss.init_count(nj, ni)),
-            u_bounds, v_bounds, occupancy=occ)
+            u_bounds, v_bounds, occupancy=occ, volp=volp)
         color, depth = ss.finalize(state)
         count = cstate.count
-    next_thr = ss.update_threshold(threshold, count, k,
+    next_thr = ss.update_threshold(threshold, count, kt,
                                    cfg.adaptive_delta, cfg.thr_min,
                                    cfg.thr_max, cfg.temporal_track)
     meta = _vdi_meta(vol, axcam, ni, nj, frame_index)

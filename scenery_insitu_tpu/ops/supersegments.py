@@ -252,7 +252,7 @@ def init_threshold_state(thr: jnp.ndarray, thr_min: float = 1e-3,
                           jnp.full_like(thr, thr_max))
 
 
-def update_threshold(state: ThresholdState, count: jnp.ndarray, max_k: int,
+def update_threshold(state: ThresholdState, count: jnp.ndarray, max_k,
                      delta: float = 0.15, thr_min: float = 1e-3,
                      thr_max: float = 2.0, track: float = 0.9
                      ) -> ThresholdState:
@@ -277,9 +277,14 @@ def update_threshold(state: ThresholdState, count: jnp.ndarray, max_k: int,
     dipping into overflow every other frame. Each frame the bracket
     decays outward by ``track`` (lo shrinking, hi growing) so a drifting
     scene re-opens the search window instead of being pinned by stale
-    bounds."""
+    bounds.
+
+    ``max_k`` may be a TRACED scalar (the occupancy-driven per-rank K
+    budget, ops/occupancy.k_budget_target) — the floor keeps the static
+    int path's band edges bit-identical (int() truncation == floor for
+    positive K)."""
     over = count > max_k
-    under = count < int(max_k * (1.0 - delta))
+    under = count < jnp.floor(max_k * (1.0 - delta))
     thr, lo, hi = state
 
     lo = jnp.where(over, thr, lo)

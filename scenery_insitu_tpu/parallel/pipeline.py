@@ -336,6 +336,14 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
     n = mesh.shape[axis]
     if width % n:
         raise ValueError(f"width {width} not divisible by mesh size {n}")
+    if comp_cfg.k_budget == "occupancy":
+        # the gather engine has no occupancy pyramid to derive budgets
+        # from — a configured-but-inert knob must land on the ledger
+        from scenery_insitu_tpu import obs as _obs
+
+        _obs.degrade("occupancy.k_budget", "occupancy", "static",
+                     "gather-engine distributed step has no occupancy "
+                     "pyramid (mxu builders only)", warn=False)
 
     def step(local_data, origin, spacing, cam: Camera) -> VDI:
         d_global = local_data.shape[0] * n
@@ -423,22 +431,64 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
 
 
 def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
-                       tf, vdi_cfg, axis, n, threshold=None):
+                       tf, vdi_cfg, axis, n, threshold=None,
+                       comp_cfg=None):
     """Per-rank slice-march VDI generation on a z-slab (shared by the
     distributed VDI and hybrid steps). Returns (vdi, meta, axcam,
     next_threshold) — the last is None unless carried temporal threshold
-    state was passed in."""
+    state was passed in.
+
+    This is where the frame's ONE occupancy pyramid is built
+    (ops/occupancy.pyramid_from_volume on the halo-exact slab) and
+    shared by every march of the generation — the legacy path re-ran the
+    permute + full-slab reduction per call site. The same pyramid's live
+    fraction drives the load-aware per-rank K budget when
+    ``comp_cfg.k_budget == "occupancy"``: a psum over the mesh turns the
+    per-rank live fractions into shares of the N*K budget
+    (occupancy.k_budget_target), so the adaptive threshold on a sparse
+    slab stops chasing the same K as the densest rank."""
     vol, gmax, v_bounds, dims = _rank_slab(local_data, origin, spacing,
                                            spec, axis, n)
+    occ_pyr = None
+    k_target = None
+    budgeted = comp_cfg is not None and comp_cfg.k_budget == "occupancy"
+    if budgeted and not vdi_cfg.adaptive:
+        # a fixed-threshold generation never consults the target — the
+        # knob is inert, so say so instead of paying the psum per frame
+        from scenery_insitu_tpu import obs as _obs
+
+        _obs.degrade("occupancy.k_budget", "occupancy", "static",
+                     "k budgets re-target the ADAPTIVE threshold; "
+                     "vdi.adaptive=False ignores them", warn=False)
+        budgeted = False
+    if spec.skip_empty or budgeted:
+        from scenery_insitu_tpu.ops import occupancy as _occ
+
+        occ_pyr = _occ.pyramid_from_volume(vol, tf, spec)
+    if budgeted:
+        from scenery_insitu_tpu import obs as _obs
+        from scenery_insitu_tpu.ops import occupancy as _occ
+
+        live = occ_pyr.live_fraction()
+        k_target = _occ.k_budget_target(
+            live, jax.lax.psum(live, axis), n,
+            vdi_cfg.max_supersegments, comp_cfg.k_budget_min)
+        rec = _obs.get_recorder()
+        rec.count("occupancy_kbudget_builds")
+        rec.event("occupancy_kbudget_build", ranks=n,
+                  k=vdi_cfg.max_supersegments,
+                  k_min=comp_cfg.k_budget_min)
     if threshold is None:
         vdi, meta, axcam = slicer.generate_vdi_mxu(
             vol, tf, cam, spec, vdi_cfg,
-            box_min=origin, box_max=gmax, v_bounds=v_bounds)
+            box_min=origin, box_max=gmax, v_bounds=v_bounds,
+            occupancy=occ_pyr, k_target=k_target)
         thr2 = None
     else:
         vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
             vol, tf, cam, spec, threshold, vdi_cfg,
-            box_min=origin, box_max=gmax, v_bounds=v_bounds)
+            box_min=origin, box_max=gmax, v_bounds=v_bounds,
+            occupancy=occ_pyr, k_target=k_target)
     # metadata must describe the GLOBAL volume, not this rank's slab
     meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
     return vdi, meta, axcam, thr2
@@ -487,7 +537,8 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
         vdi, meta, _, thr2 = _mxu_rank_generate(local_data, origin,
                                                 spacing, cam, slicer, spec,
                                                 tf, vdi_cfg, axis, n,
-                                                threshold=thr)
+                                                threshold=thr,
+                                                comp_cfg=comp_cfg)
         return (_composite_exchanged(vdi.color, vdi.depth, n, axis,
                                      comp_cfg), meta, thr2)
 
@@ -617,7 +668,7 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
     def body(local_data, origin, spacing, tr_pos, tr_vel, cam, thr):
         vdi, meta, axcam, thr2 = _mxu_rank_generate(
             local_data, origin, spacing, cam, slicer, spec, tf, vdi_cfg,
-            axis, n, threshold=thr)
+            axis, n, threshold=thr, comp_cfg=comp_cfg)
         comp = _composite_exchanged(vdi.color, vdi.depth, n, axis,
                                     comp_cfg)              # [Ko,·,Nj,Ni/n]
 
@@ -807,7 +858,7 @@ def shard_volume(data: jnp.ndarray, mesh: Mesh,
 
 
 def frame_scan(step, advance, frames: int, temporal: bool = False,
-               field=lambda s: s.field):
+               field=lambda s: s.field, sim_ranges: bool = False):
     """Roll ``frames`` (sim advance → render step → camera orbit)
     iterations into ONE ``lax.scan``-based jitted executable — a single
     launch per block instead of one executable launch per frame,
@@ -831,6 +882,13 @@ def frame_scan(step, advance, frames: int, temporal: bool = False,
     same camera the eager session loop would use. Steering (and, on the
     MXU engine, march-regime changes) can only take effect at block
     boundaries — the caller owns that check.
+
+    ``sim_ranges=True`` threads the occupancy pyramid's sim-fused update
+    through the scan body (ISSUE 6): ``advance`` must return ``(state,
+    ops/occupancy.FieldRanges)`` (e.g. grayscott.multi_step_fast_ranges)
+    and ``step`` gains a trailing ``ranges`` argument — frame i renders
+    with the ranges its own advance emitted, so no frame in the block
+    re-derives occupancy from the volume.
     """
     from scenery_insitu_tpu import obs as _obs
     from scenery_insitu_tpu.core.camera import orbit as _orbit
@@ -840,16 +898,24 @@ def frame_scan(step, advance, frames: int, temporal: bool = False,
     # stall with this rather than with the frames inside the block
     rec = _obs.get_recorder()
     rec.count("frame_scan_builds")
-    rec.event("frame_scan_build", frames=frames, temporal=temporal)
+    rec.event("frame_scan_build", frames=frames, temporal=temporal,
+              sim_ranges=sim_ranges)
 
     def run(state, origin, spacing, cam, orbit_rate, thr=None):
         def body(carry, _):
             st, cam, thr = carry
-            st = advance(st)
-            if temporal:
-                out, thr2 = step(field(st), origin, spacing, cam, thr)
+            if sim_ranges:
+                st, rng = advance(st)
+                extra = (rng,)
             else:
-                out, thr2 = step(field(st), origin, spacing, cam), thr
+                st = advance(st)
+                extra = ()
+            if temporal:
+                out, thr2 = step(field(st), origin, spacing, cam, thr,
+                                 *extra)
+            else:
+                out, thr2 = step(field(st), origin, spacing, cam,
+                                 *extra), thr
             return (st, _orbit(cam, orbit_rate), thr2), out
 
         return jax.lax.scan(body, (state, cam, thr), None, length=frames)

@@ -126,3 +126,46 @@ def multi_step_fast(state: GrayScott, n: int) -> GrayScott:
     pvec = jnp.stack([p.f, p.k, p.du, p.dv, p.dt])
     u, v = ps.multi_step_pallas(state.u, state.v, pvec, n)
     return GrayScott(u, v, p)
+
+
+def multi_step_fast_ranges(state: GrayScott, n: int, bricks=None,
+                           fused: bool = True):
+    """`multi_step_fast` that ALSO returns per-brick min/max of the
+    rendered field (ops/occupancy.FieldRanges) — the sim-fused update of
+    the frame's occupancy pyramid. The fused Pallas path emits the
+    ranges as a kernel epilogue (near-free: the slab is already in
+    VMEM); every degraded path (off-TPU, VMEM-oversized grid, Mosaic
+    rejection of the epilogue variant, or ``fused=False`` pinning the
+    XLA roll formulation) falls back to ONE lax reduction over the final
+    field in data layout (`occupancy.field_ranges` — still cheaper than
+    the legacy permute+reduce occupancy pass, and recorded on the
+    fallback ledger unless the roll path was explicitly configured).
+
+    ``bricks = (nzb, nyb)`` is the brick GRID (defaults to
+    `occupancy.default_bricks`). Returns ``(state', FieldRanges)``."""
+    from scenery_insitu_tpu import obs
+    from scenery_insitu_tpu.ops import occupancy as occ
+    from scenery_insitu_tpu.sim import pallas_stencil as ps
+
+    nzb, nyb = bricks or occ.default_bricks(state.v.shape)
+    if (fused and jax.default_backend() == "tpu"
+            and ps.fused_supported(state.u.shape)
+            and ps.ranges_supported(state.u.shape)):
+        p = state.params
+        pvec = jnp.stack([p.f, p.k, p.du, p.dv, p.dt])
+        u, v, lo, hi = ps.multi_step_pallas_ranges(state.u, state.v,
+                                                   pvec, n, nzb, nyb)
+        return GrayScott(u, v, p), occ.FieldRanges(lo, hi)
+    if fused:
+        # configured fused but the epilogue cannot ride the kernel: the
+        # advance itself still takes its own best path (multi_step_fast
+        # ledgers its own degradations); only the ranges fall back here
+        obs.degrade("occupancy.sim_ranges", "fused_epilogue",
+                    "lax_reduce",
+                    f"backend={jax.default_backend()!r}, grid="
+                    f"{tuple(state.u.shape)}: no fused ranges schedule",
+                    warn=False)
+        st = multi_step_fast(state, n)
+    else:
+        st = multi_step(state, n)
+    return st, occ.field_ranges(st.field, nzb, nyb)

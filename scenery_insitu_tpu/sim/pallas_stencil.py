@@ -49,20 +49,24 @@ _VMEM_BUDGET = int(os.environ.get("SITPU_STENCIL_VMEM_MB", "96")) \
 _PROBE_CACHE: dict = {}
 
 
-def _compile_ok(shape, t_steps: int, tz: int = 0) -> bool:
+def _compile_ok(shape, t_steps: int, tz: int = 0,
+                with_ranges: bool = False) -> bool:
     """One-time probe: does the fused kernel at this (shape, T, tz)
     actually compile on the current TPU? A VMEM budget miss surfaces as a
     Mosaic resource-exhausted error at compile time — catch it HERE,
     where a fallback exists, not inside a traced frame step where it
     cannot be caught. Cached per process (and cheap on repeats via the
-    persistent JAX compile cache)."""
-    key = (tuple(shape), int(t_steps), int(tz))
+    persistent JAX compile cache). ``with_ranges`` probes the
+    occupancy-ranges epilogue variant — a distinct kernel Mosaic may
+    judge differently."""
+    key = (tuple(shape), int(t_steps), int(tz), bool(with_ranges))
     ok = _PROBE_CACHE.get(key)
     if ok is None:
         try:
             s = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
             p = jax.ShapeDtypeStruct((5,), jnp.float32)
-            step_pallas.lower(s, s, p, t_steps=t_steps, tz=tz).compile()
+            step_pallas.lower(s, s, p, t_steps=t_steps, tz=tz,
+                              with_ranges=with_ranges).compile()
             ok = True
         except Exception:
             ok = False
@@ -93,8 +97,8 @@ def _roll(x: jnp.ndarray, shift: int, axis: int) -> jnp.ndarray:
     return pltpu.roll(x, shift % x.shape[axis], axis)
 
 
-def _kernel(t_steps, p_ref, u_ref, v_ref, uzm_ref, uzp_ref, vzm_ref,
-            vzp_ref, uo_ref, vo_ref):
+def _kernel(t_steps, with_ranges, p_ref, u_ref, v_ref, uzm_ref, uzp_ref,
+            vzm_ref, vzp_ref, uo_ref, vo_ref, *rng_refs):
     f, k, du, dv, dt = (p_ref[i] for i in range(5))
     t = t_steps
     u = jnp.concatenate([uzm_ref[...], u_ref[...], uzp_ref[...]], axis=0)
@@ -116,7 +120,15 @@ def _kernel(t_steps, p_ref, u_ref, v_ref, uzm_ref, uzp_ref, vzm_ref,
                 v + dt * (dv * lap(v) + uvv - (f + k) * v))
 
     uo_ref[...] = u[t:u.shape[0] - t]
-    vo_ref[...] = v[t:v.shape[0] - t]
+    vout = v[t:v.shape[0] - t]
+    vo_ref[...] = vout
+    if with_ranges:
+        # occupancy epilogue: per-block min/max of the RENDERED field (v)
+        # ride out of the pass as (1, 1) SMEM reductions — the slab is
+        # already in VMEM, so the ranges cost no extra HBM traffic
+        vlo_ref, vhi_ref = rng_refs
+        vlo_ref[0, 0] = jnp.min(vout)
+        vhi_ref[0, 0] = jnp.max(vout)
 
 
 def tz_candidates(shape, t_steps: int = 1) -> tuple:
@@ -166,9 +178,11 @@ def _probe_pick(shape, t: int, cands, probe, interpret: bool):
     return cands[0]
 
 
-@functools.partial(jax.jit, static_argnames=("t_steps", "interpret", "tz"))
+@functools.partial(jax.jit, static_argnames=("t_steps", "interpret", "tz",
+                                             "with_ranges"))
 def step_pallas(u: jnp.ndarray, v: jnp.ndarray, params_vec: jnp.ndarray,
-                t_steps: int = 1, interpret: bool = False, tz: int = 0):
+                t_steps: int = 1, interpret: bool = False, tz: int = 0,
+                with_ranges: bool = False):
     """Advance ``t_steps`` Gray-Scott steps in one fused kernel pass.
     ``params_vec = [f, k, du, dv, dt]`` (f32[5]). Requires
     ``pick_tz(u.shape, t_steps) > 0``.
@@ -183,7 +197,13 @@ def step_pallas(u: jnp.ndarray, v: jnp.ndarray, params_vec: jnp.ndarray,
     path, for a never-raises schedule). An EXPLICIT ``tz`` is taken on
     trust after the ``t_steps | tz | D`` shape check: it is NOT probed,
     so Mosaic resource errors surface to the caller at compile time —
-    pass probe-validated values (`_best_schedule`) when that matters."""
+    pass probe-validated values (`_best_schedule`) when that matters.
+
+    ``with_ranges=True`` appends the occupancy epilogue (ops/occupancy):
+    the return becomes ``(u', v', vlo, vhi)`` with per-z-slab min/max of
+    the updated v field shaped ``[d // tz, 1]`` — DATA-layout brick
+    ranges at the kernel's own granularity, normalized downstream by
+    `occupancy.remap_ranges`."""
     d, h, w = u.shape
     t = t_steps
     if tz:
@@ -195,7 +215,8 @@ def step_pallas(u: jnp.ndarray, v: jnp.ndarray, params_vec: jnp.ndarray,
                 f"at T={t} (need d % tz == 0 and tz % t_steps == 0)")
     else:
         tz = _probe_pick(u.shape, t, tz_candidates(u.shape, t),
-                         lambda tz_: _compile_ok(u.shape, t, tz_),
+                         lambda tz_: _compile_ok(u.shape, t, tz_,
+                                                 with_ranges),
                          interpret)
     nb = d // tz
     nb_t = d // t                 # array length in halo-block units
@@ -208,41 +229,74 @@ def step_pallas(u: jnp.ndarray, v: jnp.ndarray, params_vec: jnp.ndarray,
     zm = pl.BlockSpec((t, h, w), lambda i: ((i * r - 1) % nb_t, 0, 0))
     zp = pl.BlockSpec((t, h, w), lambda i: ((i + 1) * r % nb_t, 0, 0))
 
+    out_specs = [slab, slab]
+    out_shape = [jax.ShapeDtypeStruct((d, h, w), jnp.float32)] * 2
+    if with_ranges:
+        rng = pl.BlockSpec((1, 1), lambda i: (i, 0),
+                           memory_space=pltpu.SMEM)
+        out_specs += [rng, rng]
+        out_shape += [jax.ShapeDtypeStruct((nb, 1), jnp.float32)] * 2
+
     return pl.pallas_call(
-        functools.partial(_kernel, t),
+        functools.partial(_kernel, t, with_ranges),
         grid=(nb,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   slab, slab, zm, zp, zm, zp],
-        out_specs=[slab, slab],
-        out_shape=[jax.ShapeDtypeStruct((d, h, w), jnp.float32)] * 2,
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(params_vec, u, v, u, u, v, v)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "interpret"))
-def multi_step_pallas(u, v, params_vec, n: int, interpret: bool = False):
-    """n Gray-Scott steps, fused ``_FUSE_T`` at a time; the remainder runs
-    at progressively smaller fusion factors (greedy decomposition, so e.g.
-    n=5 runs one T=4 pass + one T=1 pass instead of silently degrading the
-    whole loop to T=1)."""
-    s = (u, v)
+def _multi_step_impl(u, v, params_vec, n: int, interpret: bool,
+                     ranges_to):
+    """Greedy multi-T schedule walk shared by `multi_step_pallas` and
+    `multi_step_pallas_ranges`. ``ranges_to = (nzb, nyb)`` threads the
+    occupancy epilogue through every pass: each kernel's native-
+    granularity v ranges are normalized onto the fixed (nzb, nyb) brick
+    grid (occupancy.remap_ranges) so the fori_loop carry keeps one shape
+    across schedules; the LAST executed pass's ranges describe the final
+    field, which is what the caller gets."""
+    with_ranges = ranges_to is not None
+    if with_ranges:
+        from scenery_insitu_tpu.ops.occupancy import (field_ranges,
+                                                      remap_ranges)
+        nzb, nyb = ranges_to
+        if n == 0:
+            # no pass runs to overwrite the seed — a (+inf, -inf) seed
+            # would gate every cell off under a band-pass TF; reduce
+            # the field as-is instead (the render-only sim_steps=0 A/B)
+            r = field_ranges(v, nzb, nyb)
+            return (u, v, r.lo, r.hi)
+        s = (u, v,
+             jnp.full((nzb, nyb), jnp.inf, jnp.float32),
+             jnp.full((nzb, nyb), -jnp.inf, jnp.float32))
+    else:
+        s = (u, v)
     remaining = n
     on_tpu = jax.default_backend() == "tpu" and not interpret
     for t in range(min(_FUSE_T, n), 0, -1):
         reps = remaining // t
         if reps == 0:
             continue
-        sched = _best_schedule(u.shape, t, on_tpu)
+        sched = _best_schedule(u.shape, t, on_tpu, with_ranges)
         if sched is None:
             continue         # Mosaic rejected this T: degrade, don't die
         kind, tz, th = sched
 
         def one(s, t=t, kind=kind, tz=tz, th=th):
             if kind == "2d":
-                return step_pallas2d(s[0], s[1], params_vec, t,
-                                     interpret=interpret, tz=tz, th=th)
-            return step_pallas(s[0], s[1], params_vec, t,
-                               interpret=interpret, tz=tz)
+                out = step_pallas2d(s[0], s[1], params_vec, t,
+                                    interpret=interpret, tz=tz, th=th,
+                                    with_ranges=with_ranges)
+            else:
+                out = step_pallas(s[0], s[1], params_vec, t,
+                                  interpret=interpret, tz=tz,
+                                  with_ranges=with_ranges)
+            if not with_ranges:
+                return out
+            un, vn, lo, hi = out
+            return (un, vn) + remap_ranges(lo, hi, ranges_to)
 
         s = jax.lax.fori_loop(0, reps, lambda _, s: one(s), s)
         remaining -= reps * t
@@ -251,6 +305,43 @@ def multi_step_pallas(u, v, params_vec, n: int, interpret: bool = False):
     if remaining:   # pick_tz(shape, 1) == 0: caller should have gated
         raise ValueError(f"grid {u.shape} does not fit the VMEM budget")
     return s
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def multi_step_pallas(u, v, params_vec, n: int, interpret: bool = False):
+    """n Gray-Scott steps, fused ``_FUSE_T`` at a time; the remainder runs
+    at progressively smaller fusion factors (greedy decomposition, so e.g.
+    n=5 runs one T=4 pass + one T=1 pass instead of silently degrading the
+    whole loop to T=1)."""
+    return _multi_step_impl(u, v, params_vec, n, interpret, None)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "nzb", "nyb",
+                                             "interpret"))
+def multi_step_pallas_ranges(u, v, params_vec, n: int, nzb: int, nyb: int,
+                             interpret: bool = False):
+    """`multi_step_pallas` with the occupancy epilogue: returns
+    ``(u', v', vlo, vhi)`` where vlo/vhi are per-brick min/max of the
+    FINAL v field on the (nzb, nyb) data-layout brick grid
+    (ops/occupancy.FieldRanges arrays) — the per-frame empty-space
+    structure rides out of the sim pass instead of costing a volume
+    sweep. Gate availability with `ranges_supported` (the epilogue
+    variant is a distinct kernel Mosaic may reject independently)."""
+    return _multi_step_impl(u, v, params_vec, n, interpret, (nzb, nyb))
+
+
+def ranges_supported(shape, t_steps: int = 1) -> bool:
+    """Can the occupancy-ranges epilogue ride the fused stencil on this
+    grid/backend? Checks the T=1 schedule (the greedy decomposition's
+    catch-all, so `multi_step_pallas_ranges` cannot hit an uncovered
+    remainder when it holds)."""
+    on_tpu = jax.default_backend() == "tpu"
+    if not (tz_candidates(shape, t_steps)
+            or tile2d_candidates(shape, t_steps)):
+        return False
+    if not on_tpu:
+        return True          # interpret mode compiles anything
+    return _best_schedule(shape, 1, True, with_ranges=True) is not None
 
 
 _FUSE_T = 4
@@ -278,10 +369,10 @@ _FUSE_T = 4
 # w is the full, truly-periodic lane axis.
 
 
-def _kernel2d(t_steps, p_ref,
+def _kernel2d(t_steps, with_ranges, p_ref,
               uc, un, us, uw, ue, unw, une, usw, use_,
               vc, vn, vs, vw, ve, vnw, vne, vsw, vse,
-              uo_ref, vo_ref):
+              uo_ref, vo_ref, *rng_refs):
     f, k, du, dv, dt = (p_ref[i] for i in range(5))
     t = t_steps
 
@@ -308,7 +399,14 @@ def _kernel2d(t_steps, p_ref,
                 v + dt * (dv * lap(v) + uvv - (f + k) * v))
 
     uo_ref[...] = u[t:u.shape[0] - t, t:u.shape[1] - t]
-    vo_ref[...] = v[t:v.shape[0] - t, t:v.shape[1] - t]
+    vout = v[t:v.shape[0] - t, t:v.shape[1] - t]
+    vo_ref[...] = vout
+    if with_ranges:
+        # occupancy epilogue (see _kernel): per-(tz, th)-block min/max
+        # of the updated field, free of extra HBM traffic
+        vlo_ref, vhi_ref = rng_refs
+        vlo_ref[0, 0] = jnp.min(vout)
+        vhi_ref[0, 0] = jnp.max(vout)
 
 
 def tile2d_candidates(shape, t_steps: int = 1) -> tuple:
@@ -339,9 +437,11 @@ def tile2d_candidates(shape, t_steps: int = 1) -> tuple:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("t_steps", "interpret", "tz", "th"))
+                   static_argnames=("t_steps", "interpret", "tz", "th",
+                                    "with_ranges"))
 def step_pallas2d(u, v, params_vec, t_steps: int = 1,
-                  interpret: bool = False, tz: int = 0, th: int = 0):
+                  interpret: bool = False, tz: int = 0, th: int = 0,
+                  with_ranges: bool = False):
     """Advance ``t_steps`` steps in one 2D-blocked fused pass.
 
     Same auto-pick contract as `step_pallas` (ADVICE r5 #4): ``(0, 0)``
@@ -352,7 +452,11 @@ def step_pallas2d(u, v, params_vec, t_steps: int = 1,
     ``(tz, th)`` must satisfy ``T | tz | D`` and ``T | th | H`` (the
     `tile2d_candidates` lattice) and is then taken on trust — unprobed,
     so Mosaic errors surface at compile time; route through
-    `_best_schedule` for probe-validated tiles."""
+    `_best_schedule` for probe-validated tiles.
+
+    ``with_ranges=True`` appends the occupancy epilogue: the return
+    becomes ``(u', v', vlo, vhi)`` with per-(z, y)-block min/max of the
+    updated v shaped ``[d // tz, h // th]`` (see `step_pallas`)."""
     d, h, w = u.shape
     t = t_steps
     if tz or th:
@@ -369,7 +473,8 @@ def step_pallas2d(u, v, params_vec, t_steps: int = 1,
     else:
         tz, th = _probe_pick(
             u.shape, t, tile2d_candidates(u.shape, t),
-            lambda c: _compile2d_ok(u.shape, t, c[0], c[1]), interpret)
+            lambda c: _compile2d_ok(u.shape, t, c[0], c[1], with_ranges),
+            interpret)
     nzb, nhb = d // tz, h // th
     nz_t, nh_t = d // t, h // t    # array length in halo-block units
     rz, rh = tz // t, th // t
@@ -394,26 +499,36 @@ def step_pallas2d(u, v, params_vec, t_steps: int = 1,
                                     (j + 1) * rh % nh_t, 0))
 
     specs = [c_, n_, s_, w_, e_, nw, ne, sw, se]
+    out_specs = [c_, c_]
+    out_shape = [jax.ShapeDtypeStruct((d, h, w), jnp.float32)] * 2
+    if with_ranges:
+        rng = pl.BlockSpec((1, 1), lambda i, j: (i, j),
+                           memory_space=pltpu.SMEM)
+        out_specs += [rng, rng]
+        out_shape += [jax.ShapeDtypeStruct((nzb, nhb), jnp.float32)] * 2
     return pl.pallas_call(
-        functools.partial(_kernel2d, t),
+        functools.partial(_kernel2d, t, with_ranges),
         grid=(nzb, nhb),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + specs + specs,
-        out_specs=[c_, c_],
-        out_shape=[jax.ShapeDtypeStruct((d, h, w), jnp.float32)] * 2,
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(params_vec, *([u] * 9), *([v] * 9))
 
 
-def _compile2d_ok(shape, t_steps: int, tz: int, th: int) -> bool:
+def _compile2d_ok(shape, t_steps: int, tz: int, th: int,
+                  with_ranges: bool = False) -> bool:
     """Mosaic probe for the 2D kernel at (shape, T, tz, th); cached."""
-    key = ("2d", tuple(shape), int(t_steps), int(tz), int(th))
+    key = ("2d", tuple(shape), int(t_steps), int(tz), int(th),
+           bool(with_ranges))
     ok = _PROBE_CACHE.get(key)
     if ok is None:
         try:
             s = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
             p = jax.ShapeDtypeStruct((5,), jnp.float32)
             step_pallas2d.lower(s, s, p, t_steps=t_steps,
-                                tz=tz, th=th).compile()
+                                tz=tz, th=th,
+                                with_ranges=with_ranges).compile()
             ok = True
         except Exception:
             ok = False
@@ -451,10 +566,11 @@ def modeled_sim_traffic(shape, n: int, fused: bool = True) -> float:
     return total
 
 
-def _best_schedule(shape, t: int, on_tpu: bool):
+def _best_schedule(shape, t: int, on_tpu: bool, with_ranges: bool = False):
     """Pick the cheapest compiling schedule for a T-step pass: 2D tiles
     and 1D slabs compete on modeled HBM traffic per step; the Mosaic
-    probe (capped walk) has the final word. Returns ("2d", tz, th),
+    probe (capped walk) has the final word. ``with_ranges`` probes the
+    occupancy-epilogue kernel variant instead. Returns ("2d", tz, th),
     ("1d", tz, None) or None."""
     opts = []
     for tz, th in tile2d_candidates(shape, t)[:2]:
@@ -467,8 +583,8 @@ def _best_schedule(shape, t: int, on_tpu: bool):
     for _, kind, tz, th in opts[:3]:
         if not on_tpu:
             return kind, tz, th
-        ok = (_compile2d_ok(shape, t, tz, th) if kind == "2d"
-              else _compile_ok(shape, t, tz))
+        ok = (_compile2d_ok(shape, t, tz, th, with_ranges) if kind == "2d"
+              else _compile_ok(shape, t, tz, with_ranges))
         if ok:
             return kind, tz, th
     if opts:

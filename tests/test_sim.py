@@ -112,7 +112,8 @@ def test_stencil_compile_probe_gates_fused_path():
     assert ps.pick_tz(shape) > 0
     ps._PROBE_CACHE.clear()
     assert ps._compile_ok(shape, 1) is False        # swallowed, not raised
-    assert ps._PROBE_CACHE[(shape, 1, 0)] is False  # cached (tz=0 = auto)
+    # cached (tz=0 = auto, ranges-epilogue variant off)
+    assert ps._PROBE_CACHE[(shape, 1, 0, False)] is False
     # fused_supported skips the probe off-TPU (interpret mode is safe)
     assert ps.fused_supported(shape)
     ps._PROBE_CACHE.clear()

@@ -36,6 +36,9 @@ BASELINE primary scale 512^3 x 25 frames; the CPU fallback drops to
     set SITPU_BENCH_FOLD, for fixed-fold A/B captures)
   SITPU_BENCH_SCAN_FRAMES=1  (whole frame loop in ONE lax.scan launch)
   SITPU_BENCH_SIM_STEPS=0    (render-only: static field, moving camera)
+  SITPU_BENCH_SCHEDULE=frame|waves  SITPU_BENCH_WAVE_TILES=4  (tile-wave
+    pipelined frames — docs/PERF.md "Tile waves"; single-chip it carries
+    the config + modeled 8-rank overlap into the artifact)
 The second consecutive tpu attempt falls back to SITPU_BENCH_FOLD=seg
 (the same segmented-scan fold without Mosaic exposure) — but only if a
 TPU child actually ran and died, so a probe-level tunnel flap never
@@ -145,15 +148,19 @@ def _model_frame_bytes(grid: int, sim_steps: int, marches: int,
 
 
 def _mod_exchange(n: int, k: int, height: int, width: int,
-                  exchange: str, wire: str) -> dict:
+                  exchange: str, wire: str, schedule: str = "frame",
+                  wave_tiles: int = 1) -> dict:
     """Modeled per-rank sort-last exchange bytes for the configured
     wire/schedule at an n-rank shape (ops.composite.modeled_exchange_traffic
-    — probe-free, so the single-chip bench can still report the lever)."""
+    — probe-free, so the single-chip bench can still report the lever).
+    ``schedule="waves"`` adds the tile-wave overlap accounting (what
+    fraction of the exchange hides behind march compute)."""
     from scenery_insitu_tpu.ops.composite import modeled_exchange_traffic
 
     return modeled_exchange_traffic(
         n, k, height, width, k_out=k,
-        mode=("ring" if exchange == "ring" else "all_to_all"), wire=wire)
+        mode=("ring" if exchange == "ring" else "all_to_all"), wire=wire,
+        schedule=schedule, wave_tiles=wave_tiles)
 
 
 def _slice_march_flops(spec, grid: int, marches: int) -> float:
@@ -243,6 +250,13 @@ def main():
     # shrink is composite_bench's to measure; here the knob carries the
     # config and the modeled per-wire exchange bytes into the artifact
     wire = os.environ.get("SITPU_BENCH_WIRE", "f32")
+    # frame schedule A/B (docs/PERF.md "Tile waves"): single-chip frames
+    # have no exchange to overlap (waves degrade to frame on the ledger),
+    # so like the exchange/wire knobs this carries the config and the
+    # modeled 8-rank overlap accounting into the artifact; the measured
+    # distributed A/B is benchmarks/composite_bench.py --schedule both
+    schedule = os.environ.get("SITPU_BENCH_SCHEDULE", "frame")
+    wave_tiles = _env_int("SITPU_BENCH_WAVE_TILES", 4)
 
     from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
@@ -272,7 +286,9 @@ def main():
                               adaptive_mode=ad_mode),
             comp_cfg=CompositeConfig(max_output_supersegments=k,
                                      adaptive_iters=ad_iters,
-                                     exchange=exchange, wire=wire),
+                                     exchange=exchange, wire=wire,
+                                     schedule=schedule,
+                                     wave_tiles=wave_tiles),
             engine=engine, grid_shape=(grid, grid, grid),
             axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
             slicer_cfg=mc, render_dtype=render_dtype, sim_fused=sim_fused,
@@ -524,13 +540,14 @@ def main():
         # 8-rank distributed shape of this config (modeled — single-chip
         # runs have no exchange; composite_bench measures the real one)
         "modeled_exchange_8rank": _mod_exchange(
-            8, k, height, width, exchange, wire),
+            8, k, height, width, exchange, wire, schedule, wave_tiles),
         "occupancy": occupancy_info,
         "degradations": obs.ledger(),
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "sim_fused": sim_fused, "exchange": exchange,
-                   "wire": wire, "skip": skip_mode,
+                   "wire": wire, "schedule": schedule,
+                   "wave_tiles": wave_tiles, "skip": skip_mode,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
                    "chunk": chunk, "scan_frames": bool(scan_frames),
                    "autotune_ms": autotune_ms,

@@ -106,6 +106,15 @@ def main():
                     help="ici-mode supersegment wire format(s) to run "
                          "(lossy modes always run f32 too, as the PSNR "
                          "reference)")
+    ap.add_argument("--schedule", default="frame",
+                    choices=("frame", "waves", "both"),
+                    help="frame schedule(s) to run (docs/PERF.md 'Tile "
+                         "waves'): 'waves' scans the exchange+composite "
+                         "per column-block wave; 'both' A/Bs them and "
+                         "reports parity + the modeled overlap win")
+    ap.add_argument("--wave-tiles", type=int, default=4,
+                    help="column-block waves per rank block under the "
+                         "waves schedule")
     ap.add_argument("--out", default=None,
                     help="also write the JSON summary to PATH (CI artifact)")
     ap.add_argument("--codec", default="zstd")
@@ -176,7 +185,8 @@ def main():
 
         from scenery_insitu_tpu.ops.composite import modeled_exchange_traffic
         from scenery_insitu_tpu.parallel.mesh import make_mesh
-        from scenery_insitu_tpu.parallel.pipeline import _composite_exchanged
+        from scenery_insitu_tpu.parallel.pipeline import (
+            _composite_exchanged_sched)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = make_mesh(n)
@@ -187,23 +197,31 @@ def main():
                  else [args.wire])
         if "f32" not in wires:          # the lossy modes' PSNR reference
             wires = ["f32"] + wires
+        scheds = (["frame", "waves"] if args.schedule == "both"
+                  else [args.schedule])
 
         base_c = jnp.concatenate([v.color for v in vdis])
         base_d = jnp.concatenate([v.depth for v in vdis])
 
         per_mode = {}
         first_out = {}
-        for mode in modes:
-            for wire in wires:
-                # f32 entries keep the bare exchange-mode key (the PR-4
-                # artifact shape); lossy wires nest under "mode/wire"
+        for sched in scheds:
+            for mode in modes:
+              for wire in wires:
+                # f32 frame entries keep the bare exchange-mode key (the
+                # PR-4 artifact shape); lossy wires nest under
+                # "mode/wire" and the waves schedule under "waves/..."
                 key = mode if wire == "f32" else f"{mode}/{wire}"
+                if sched == "waves":
+                    key = f"waves/{key}"
                 cfg_m = dataclasses.replace(comp_cfg, exchange=mode,
                                             ring_slots=args.ring_slots,
-                                            wire=wire)
+                                            wire=wire, schedule=sched,
+                                            wave_tiles=args.wave_tiles)
 
                 def step(color, depth, cfg_m=cfg_m):  # [K,4,H,W] per rank
-                    out = _composite_exchanged(color, depth, n, axis, cfg_m)
+                    out = _composite_exchanged_sched(color, depth, n,
+                                                     axis, cfg_m)
                     return out.color, out.depth
 
                 f = jax.jit(shard_map(
@@ -241,24 +259,48 @@ def main():
                 per_mode[key] = {
                     "ms_per_iter": round(total / args.iters * 1000, 3),
                     # modeled per-rank exchange + composite working set —
-                    # the N·K → ring_slots+K live-state lever and the
-                    # per-wire ici byte shrink (docs/PERF.md)
+                    # the N·K → ring_slots+K live-state lever, the
+                    # per-wire ici byte shrink, and (waves) the overlap
+                    # accounting (docs/PERF.md)
                     "modeled": modeled_exchange_traffic(
                         n, k, h, w, k_out=args.k_out, mode=mode,
-                        ring_slots=args.ring_slots, wire=wire),
+                        ring_slots=args.ring_slots, wire=wire,
+                        schedule=sched, wave_tiles=args.wave_tiles),
                     "cost_analysis": snap,
                 }
 
+        key0 = (modes[0] if scheds[0] == "frame"
+                else f"waves/{modes[0]}")
         summary = {
             "metric": f"composite_ici_{n}ranks_k{k}_{w}x{h}",
-            "value": per_mode[modes[0]]["ms_per_iter"],
+            "value": per_mode[key0]["ms_per_iter"],
             "unit": "ms/iter",
             "mode": "ici",
             "exchange": per_mode,
             "ring_slots": args.ring_slots,
             "wire": args.wire,
+            "schedule": args.schedule,
+            "wave_tiles": args.wave_tiles,
             "backend": jax.default_backend(),
         }
+        if len(scheds) == 2:
+            # parity of the two SCHEDULES on the same inputs at the first
+            # exchange mode: lossless waves must reproduce the frame
+            # schedule's composite (the tile is a column partition of
+            # the same per-pixel merge)
+            fc, fd = first_out[modes[0]]
+            wc, wd = first_out[f"waves/{modes[0]}"]
+            dc = float(np.abs(fc - wc).max())
+            fin = np.isfinite(fd) & np.isfinite(wd)
+            dd = float(np.abs(fd[fin] - wd[fin]).max()) if fin.any() \
+                else 0.0
+            summary["schedule_parity"] = {
+                "exchange": modes[0],
+                "max_abs_diff_color": dc,
+                "max_abs_diff_depth_finite": dd,
+                "empty_slot_layout_match":
+                    bool((np.isinf(fd) == np.isinf(wd)).all()),
+            }
         if len(wires) > 1:
             # PSNR of each lossy wire's same-view render against the
             # SAME schedule's f32 output — the quality side of the 4×
@@ -275,15 +317,22 @@ def main():
                         _VDI(jnp.asarray(oc), jnp.asarray(od))))
                 return _rendered[key]
 
+            pfx = {"frame": "", "waves": "waves/"}
             summary["wire_psnr_db"] = {
-                f"{mode}/{wire}": round(psnr(rend(f"{mode}/{wire}"),
-                                             rend(mode)), 2)
-                for mode in modes for wire in wires if wire != "f32"}
+                f"{pfx[s]}{mode}/{wire}":
+                    round(psnr(rend(f"{pfx[s]}{mode}/{wire}"),
+                               rend(f"{pfx[s]}{mode}")), 2)
+                for s in scheds for mode in modes
+                for wire in wires if wire != "f32"}
         if len(modes) == 2:
-            # parity of the two schedules on the SAME (unperturbed)
-            # inputs: lossless ring must match all_to_all exactly
-            ac, ad = first_out["all_to_all"]
-            rc, rd = first_out["ring"]
+            # parity of the two exchange modes on the SAME (unperturbed)
+            # inputs: lossless ring must match all_to_all exactly — under
+            # whichever schedule actually ran (a waves-only run compares
+            # its own waves/ keys instead of silently skipping)
+            pfx = "" if "frame" in scheds else "waves/"
+            summary["parity_schedule"] = "frame" if not pfx else "waves"
+            ac, ad = first_out[pfx + "all_to_all"]
+            rc, rd = first_out[pfx + "ring"]
             dc = float(np.abs(ac - rc).max())
             fin = np.isfinite(ad) & np.isfinite(rd)
             dd = float(np.abs(ad[fin] - rd[fin]).max()) if fin.any() else 0.0

@@ -1,0 +1,196 @@
+"""Modeled 8-rank Config-2 projection — the per-lever ms/frame stack
+ROADMAP item 1 owes when the TPU tunnel is unreachable (commit the model
+with stated assumptions rather than nothing).
+
+Composes the EXISTING committed traffic models — nothing new is invented
+here, the stack is just their sum at the BASELINE.md Config-2 shape
+(8 ranks, 512^3 global Gray-Scott, 640x640 intermediate grid, K=16,
+temporal adaptive = one march/frame):
+
+- sim:       sim.pallas_stencil.modeled_sim_traffic (fused vs roll)
+- march:     one volume read of the rank slab per march, f32 vs bf16
+             (SliceMarchConfig.render_dtype), scaled by the committed
+             sim-fused occupancy-pyramid reduction
+             (benchmarks/results/occupancy_ab_r06_512.json, 2.43x)
+- exchange:  ops.composite.modeled_exchange_traffic (all_to_all vs ring,
+             f32 vs qpack8 wire, frame vs waves schedule — the waves row
+             charges only the EXPOSED exchange bytes, docs/PERF.md
+             "Tile waves")
+- composite: the same model's stream_bytes_per_rank (merge working set
+             + k_out output write)
+
+Every row converts bytes -> ms with the stated bandwidth assumptions and
+adds them (a traffic LOWER BOUND: compute, dispatch and host time are
+excluded; the measured flagship runs well below peak bandwidth, so the
+honest reading is the RELATIVE per-lever deltas, not the absolute ms).
+The flagship datum (419.43 ms/frame, 1 chip, pre-lever) is carried for
+reference. Usage:
+
+    python benchmarks/modeled_projection.py \
+        [--out benchmarks/results/modeled_projection_r08.json]
+
+No accelerator access — safe anywhere (JAX_PLATFORMS=cpu is fine).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+R = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+# ---- Config-2 shape (BASELINE.md; flagship capture bench_tpu_r4_512) ----
+RANKS = 8
+GRID = 512
+SIM_STEPS = 10
+NI = NJ = 640                    # flagship intermediate grid at 512^3
+K = 16
+WAVE_TILES = 4
+
+# ---- bandwidth assumptions (stated, not measured) ----
+# v5e HBM data-sheet peak; the flagship capture achieved ~8.4% of it, so
+# absolute ms here are optimistic floors — the deltas are the signal.
+HBM_GBPS = 819.0
+# effective per-link ICI assumption for a v5e 1-D ring (conservative
+# fraction of the ~400 GB/s aggregate the data sheet quotes per chip).
+ICI_GBPS = 45.0
+
+
+def _load(rel, default=None):
+    try:
+        with open(os.path.join(R, rel)) as f:
+            return json.load(f)
+    except Exception:
+        return default
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON artifact to PATH")
+    args = ap.parse_args()
+
+    from scenery_insitu_tpu.ops.composite import modeled_exchange_traffic
+    from scenery_insitu_tpu.sim import pallas_stencil as ps
+
+    flagship = _load("bench_tpu_r4_512.json", {})
+    base_ms = float(flagship.get("ms_per_frame", 419.43))
+
+    occ = _load("occupancy_ab_r06_512.json", {})
+    pyr_reduction = float(
+        (occ.get("model") or {}).get("reduction_vs_off", {}).get("sim",
+                                                                 2.43))
+
+    slab = (GRID // RANKS, GRID, GRID)
+    slab_vox = slab[0] * slab[1] * slab[2]
+
+    def ms_hbm(nbytes):
+        return nbytes / (HBM_GBPS * 1e9) * 1e3
+
+    def ms_ici(nbytes):
+        return nbytes / (ICI_GBPS * 1e9) * 1e3
+
+    def row(lever, sim_fused, march_bytes_per_vox, march_scale,
+            exchange, wire, ring_slots, schedule, note):
+        sim_b = ps.modeled_sim_traffic(slab, SIM_STEPS, fused=sim_fused)
+        march_b = slab_vox * march_bytes_per_vox / march_scale
+        ex = modeled_exchange_traffic(
+            RANKS, K, NJ, NI, k_out=K, mode=exchange,
+            ring_slots=ring_slots, wire=wire, schedule=schedule,
+            wave_tiles=WAVE_TILES)
+        ici_b = (ex["ici_bytes_exposed_per_rank"]
+                 if schedule == "waves" else ex["ici_bytes_per_rank"])
+        stream_b = ex["stream_bytes_per_rank"]
+        total = (ms_hbm(sim_b + march_b + stream_b) + ms_ici(ici_b))
+        return {
+            "lever": lever,
+            "config": {"sim_fused": sim_fused,
+                       "render_dtype": ("bf16" if march_bytes_per_vox == 2
+                                        else "f32"),
+                       "occupancy_march_reduction": march_scale,
+                       "exchange": exchange, "wire": wire,
+                       "ring_slots": ring_slots, "schedule": schedule},
+            "bytes": {"sim_hbm": round(sim_b),
+                      "march_hbm": round(march_b),
+                      "composite_stream_hbm": round(stream_b),
+                      "exchange_ici_exposed": round(ici_b),
+                      "exchange_ici_total": ex["ici_bytes_per_rank"]},
+            "ms": {"sim": round(ms_hbm(sim_b), 2),
+                   "march": round(ms_hbm(march_b), 2),
+                   "composite_stream": round(ms_hbm(stream_b), 3),
+                   "exchange_exposed": round(ms_ici(ici_b), 3)},
+            "modeled_ms_per_frame": round(total, 2),
+            "note": note,
+        }
+
+    stack = [
+        row("baseline_no_levers", False, 4, 1.0, "all_to_all", "f32", 0,
+            "frame", "roll-formulation sim, f32 march, monolithic "
+            "all_to_all frame — the pre-PR-1 schedule at 8 ranks"),
+        row("+sim_fused_stencil", True, 4, 1.0, "all_to_all", "f32", 0,
+            "frame", "time-fused Pallas stencil (PR 1): T steps per "
+            "u,v round trip"),
+        row("+bf16_march", True, 2, 1.0, "all_to_all", "f32", 0,
+            "frame", "bf16 marched-volume copy (PR 1): march + halo "
+            "bytes halve, f32 accumulation"),
+        row("+simfused_occupancy_pyramid", True, 2, pyr_reduction,
+            "all_to_all", "f32", 0, "frame",
+            f"sim-fused value-range pyramid (PR 6): march reads / "
+            f"{pyr_reduction} at the committed 512^3 live fraction"),
+        row("+ring_exchange", True, 2, pyr_reduction, "ring", "f32", K,
+            "frame", "ring ppermute chain with ring_slots=K (PR 4): "
+            "merge working set N*K -> 2K"),
+        row("+qpack8_wire", True, 2, pyr_reduction, "ring", "qpack8", K,
+            "frame", "qpack8 supersegment wire (PR 5): ICI bytes / 4"),
+        row("+tile_waves", True, 2, pyr_reduction, "ring", "qpack8", K,
+            "waves", f"tile-wave pipeline (this PR): {WAVE_TILES} waves "
+            f"hide {(WAVE_TILES - 1)}/{WAVE_TILES} of the exchange "
+            "behind march compute — only the last wave's bytes stay on "
+            "the critical path"),
+    ]
+    b0 = stack[0]["modeled_ms_per_frame"]
+    for r_ in stack:
+        r_["speedup_vs_baseline"] = round(b0 / r_["modeled_ms_per_frame"],
+                                          2)
+
+    out = {
+        "metric": f"modeled_projection_{RANKS:02d}rank_config2_{GRID}",
+        "value": stack[-1]["modeled_ms_per_frame"],
+        "unit": "ms/frame (modeled lower bound)",
+        "baseline_ms_per_frame": base_ms,
+        "baseline_artifact": "benchmarks/results/bench_tpu_r4_512.json",
+        "modeled_stack_speedup": stack[-1]["speedup_vs_baseline"],
+        "assumptions": {
+            "ranks": RANKS, "grid": GRID, "sim_steps": SIM_STEPS,
+            "intermediate": [NI, NJ], "k": K,
+            "wave_tiles": WAVE_TILES,
+            "marches_per_frame": 1,
+            "hbm_gbps": HBM_GBPS, "ici_gbps_effective": ICI_GBPS,
+            "occupancy_march_reduction_source":
+                "benchmarks/results/occupancy_ab_r06_512.json (sim row)",
+            "excluded": "compute time, kernel launch/dispatch, host "
+                        "fetch, fold-state traffic beyond the composite "
+                        "stream model — this is a TRAFFIC lower bound; "
+                        "the flagship runs at ~8.4% of HBM peak, so "
+                        "read the RELATIVE deltas, not the absolute ms",
+            "note_sim_attribution": "the '~290 of 419 ms is sim' split "
+                                    "(ROADMAP item 1) is still "
+                                    "hardware-unconfirmed; this model "
+                                    "keeps sim and render terms "
+                                    "separate so either outcome maps "
+                                    "onto a subset of rows",
+        },
+        "stack": stack,
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,14 +15,24 @@ R = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
 def rows_of(path: str):
     out = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or not line.startswith("{"):
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                pass
+        text = f.read()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or not line.startswith("{"):
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+    if not out:
+        # pretty-printed (multi-line) artifacts — composite/wire/waves
+        # A/Bs and the modeled projection are written with indent
+        try:
+            doc = json.loads(text)
+            if isinstance(doc, dict):
+                out.append(doc)
+        except json.JSONDecodeError:
+            pass
     return out
 
 
@@ -36,6 +46,43 @@ def fmt(r: dict) -> str:
         w = r["workload"]
         return (f"{r.get('metric', '?')}: {r['ms_per_frame']:.0f} ms/frame "
                 f"{w} mode={r.get('mode')} n={r.get('n_devices')}")
+    if isinstance(r.get("exchange"), dict):      # composite/wire/waves A/B
+        lines = [f"{r.get('metric', 'composite_ab')}: "
+                 f"[{r.get('backend', '?')}]"]
+        for key, e in sorted(r["exchange"].items()):
+            mod = e.get("modeled") or {}
+            extra = ""
+            if "ici_bytes_per_rank" in mod:
+                extra = f"  ici={mod['ici_bytes_per_rank']}B/rank"
+            if mod.get("schedule") == "waves":
+                extra += (f" hidden={mod.get('overlap_hidden_frac')} "
+                          f"(T={mod.get('wave_tiles')})")
+            lines.append(f"  {key:22s} {e.get('ms_per_iter')} ms/iter"
+                         f"{extra}")
+        if "wire_psnr_db" in r:
+            lines.append(f"  psnr_db={r['wire_psnr_db']}")
+        for pk in ("parity", "schedule_parity"):
+            if pk in r:
+                lines.append(
+                    f"  {pk}: max|dcolor|="
+                    f"{r[pk].get('max_abs_diff_color')}")
+        return "\n   ".join(lines)
+    if "measured" in r and "model" in r:         # occupancy A/B
+        modes = (r["measured"] or {}).get("modes", {})
+        ms = " ".join(f"{m}={v.get('ms_per_frame')}ms"
+                      for m, v in modes.items() if isinstance(v, dict))
+        red = (r["model"] or {}).get("reduction_vs_off", {})
+        return (f"{r.get('metric', 'occupancy_ab')}: {ms}"
+                f"  model reduction_vs_off={red}")
+    if "stack" in r:                             # modeled projection
+        lines = [f"{r.get('metric', 'modeled_projection')}: "
+                 f"{r.get('value')} {r.get('unit', '')} "
+                 f"(vs {r.get('baseline_ms_per_frame')} ms flagship)"]
+        for row in r["stack"]:
+            lines.append(f"  {row.get('lever', '?'):34s} "
+                         f"{row.get('modeled_ms_per_frame')} ms/frame "
+                         f"x{row.get('speedup_vs_baseline')}")
+        return "\n   ".join(lines)
     if "metric" in r:
         val = r.get("value")
         unit = r.get("unit", "")

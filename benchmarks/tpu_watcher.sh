@@ -1,0 +1,181 @@
+#!/bin/bash
+# Consolidated TPU-window watcher — supersedes the five per-round copies
+# (tpu_watcher_r3.sh .. tpu_watcher_r5.sh; their round logs live in
+# benchmarks/results/README.md). Same resumable skeleton the r4/r5
+# rounds converged on: probe the tunnel before EVERY step, output file =
+# done marker (relaunch resumes), a per-step fail counter retires steps
+# that died MAXFAIL times while the tunnel was alive, and a hard
+# deadline hands the chip back to the driver. Round and knobs come from
+# the environment instead of a fork-per-round copy:
+#
+#   SITPU_WATCHER_ROUND=r8         artifact suffix (results/*_${ROUND}.*)
+#   SITPU_WATCHER_STEPS="1 2 5"    run a subset (default: all, in order)
+#   SITPU_WATCHER_MAXFAIL=2        tunnel-alive failures before retiring
+#   SITPU_WATCHER_DEADLINE=<epoch> hard stop (default: +6h from launch)
+#   SITPU_WATCHER_POLLS=900        probe attempts before giving up
+#   SITPU_WATCHER_SLEEP=45         seconds between dead-tunnel probes
+#
+# Any SITPU_BENCH_* in the environment passes through to every step, so
+# one-off knob sweeps don't need to edit the queue. The companion
+# benchmarks/tpu_when_ready.sh stays the minimal "poll then capture the
+# defaults" one-shot.
+# Log: /tmp/tpu_watcher_${ROUND}.log
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p benchmarks/results
+R=benchmarks/results
+ROUND=${SITPU_WATCHER_ROUND:-r8}
+L=/tmp/tpu_watcher_${ROUND}.log
+MAXFAIL=${SITPU_WATCHER_MAXFAIL:-2}
+DEADLINE=${SITPU_WATCHER_DEADLINE:-$(($(date +%s) + 6 * 3600))}
+LAYOUT=${ROUND}v1
+if [ "$(cat /tmp/watcher_layout 2>/dev/null)" != "$LAYOUT" ]; then
+  rm -f /tmp/watcher_fail.*
+  echo "$LAYOUT" > /tmp/watcher_layout
+fi
+
+probe() {
+  timeout 120 python - <<'EOF' 2>/dev/null
+import jax
+assert jax.devices()[0].platform == "tpu"
+import jax.numpy as jnp
+assert float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()) > 0
+EOF
+}
+
+# Keep an output only if the command succeeded AND its last line parses
+# as JSON (a timed-out step must not leave a file that reads as a
+# captured measurement). Failures keep the raw output as *.failed.
+run_json() {
+  local out="$1" tmo="$2"; shift 2
+  if timeout "$tmo" "$@" > "$out.full.tmp" 2>>"$L" \
+     && tail -1 "$out.full.tmp" > "$out.tmp" \
+     && python -c "import json,sys; json.load(open(sys.argv[1]))" \
+          "$out.tmp" 2>>"$L"; then
+    mv "$out.tmp" "$out"; rm -f "$out.full.tmp" "$out.failed"
+    echo "ok: $out $(date -u +%H:%M:%S)" >> "$L"
+    cat "$out"
+  else
+    if [ -s "$out.full.tmp" ]; then mv "$out.full.tmp" "$out.failed"; fi
+    rm -f "$out.tmp" "$out.full.tmp"
+    echo "FAILED: $out $(date -u +%H:%M:%S)" >> "$L"
+  fi
+}
+
+# Whole-file artifacts (JSONL sweeps, profiles): keep on success, keep
+# partial output as *.partial on failure (resumable sweeps).
+run_jsonl() {
+  local out="$1" tmo="$2"; shift 2
+  if timeout "$tmo" "$@" > "$out.tmp" 2>>"$L"; then
+    mv "$out.tmp" "$out"; echo "ok: $out $(date -u +%H:%M:%S)" >> "$L"
+    cat "$out"
+  else
+    if [ -s "$out.tmp" ]; then mv "$out.tmp" "$out.partial"; fi
+    rm -f "$out.tmp"; echo "FAILED: $out $(date -u +%H:%M:%S)" >> "$L"
+  fi
+}
+
+# ---- the round-8 queue (short one-compile captures first; ROADMAP
+# item 1's per-lever hardware A/Bs + this round's waves schedule) ----
+run_step() {
+  case "$1" in
+    # flagship 512^3, fixed default fold (the lever-stack re-capture)
+    1) run_json "$R/bench_tpu_${ROUND}_512.json" 1000 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_PLATFORMS=tpu,tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=420 python bench.py ;;
+    # 30-second micro-roofline (finishes hbm_bench's owed TPU capture)
+    2) run_json "$R/hbm_micro_tpu_${ROUND}.json" 600 \
+         python benchmarks/hbm_bench.py ;;
+    # render-only flagship (sim_steps=0 — the sim-vs-render split)
+    3) run_json "$R/bench_tpu_${ROUND}_512_render.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_SIM_STEPS=0 \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
+         python bench.py ;;
+    # sim-fused occupancy pyramid at 512^3 (ROADMAP item 3's owed A/B)
+    4) run_json "$R/bench_tpu_${ROUND}_512_skipsim.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_SKIP=sim \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
+         python bench.py ;;
+    # tile-wave flagship (single-chip: schedule config + modeled overlap
+    # in the artifact; the measured distributed A/B is step 6)
+    5) run_json "$R/bench_tpu_${ROUND}_512_waves.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_SCHEDULE=waves \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
+         python bench.py ;;
+    # waves-vs-frame measured A/B on real device(s) (clamps to 1 chip)
+    6) run_json "$R/composite_waves_tpu_${ROUND}.json" 1200 env \
+         SITPU_BENCH_REAL=1 python benchmarks/composite_bench.py \
+         --schedule both --exchange ring \
+         --out "$R/composite_waves_tpu_${ROUND}.json" ;;
+    # wire + exchange matrix on real device(s)
+    7) run_json "$R/composite_wire_tpu_${ROUND}.json" 1200 env \
+         SITPU_BENCH_REAL=1 python benchmarks/composite_bench.py \
+         --wire all --out "$R/composite_wire_tpu_${ROUND}.json" ;;
+    # occupancy ladder A/B at 512 (dedicated bench, measured ms/frame)
+    8) run_json "$R/occupancy_ab_tpu_${ROUND}_512.json" 1800 \
+         python benchmarks/occupancy_bench.py --grid 512 \
+         --out "$R/occupancy_ab_tpu_${ROUND}_512.json" ;;
+    # whole-loop-in-one-jit flagship (scan dispatch tax isolation)
+    9) run_json "$R/bench_tpu_${ROUND}_512_scanloop.json" 900 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_SCAN_FRAMES=1 \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=700 \
+         python bench.py ;;
+    # the 1024^3 north-star attempt (a diagnosed OOM is also a result)
+    10) run_json "$R/bench_tpu_${ROUND}_1024.json" 2100 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_GRID=1024 \
+         SITPU_BENCH_FRAMES=5 SITPU_BENCH_PLATFORMS=tpu \
+         SITPU_BENCH_CHILD_TIMEOUT=1800 python bench.py ;;
+  esac
+}
+
+step_out() {
+  case "$1" in
+    1) echo "$R/bench_tpu_${ROUND}_512.json" ;;
+    2) echo "$R/hbm_micro_tpu_${ROUND}.json" ;;
+    3) echo "$R/bench_tpu_${ROUND}_512_render.json" ;;
+    4) echo "$R/bench_tpu_${ROUND}_512_skipsim.json" ;;
+    5) echo "$R/bench_tpu_${ROUND}_512_waves.json" ;;
+    6) echo "$R/composite_waves_tpu_${ROUND}.json" ;;
+    7) echo "$R/composite_wire_tpu_${ROUND}.json" ;;
+    8) echo "$R/occupancy_ab_tpu_${ROUND}_512.json" ;;
+    9) echo "$R/bench_tpu_${ROUND}_512_scanloop.json" ;;
+    10) echo "$R/bench_tpu_${ROUND}_1024.json" ;;
+  esac
+}
+
+NSTEPS=10
+STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
+POLLS=${SITPU_WATCHER_POLLS:-900}
+SLEEP=${SITPU_WATCHER_SLEEP:-45}
+
+for i in $(seq 1 "$POLLS"); do
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "deadline reached, exiting so the driver owns the chip $(date -u)" \
+      >> "$L"
+    exit 0
+  fi
+  next=""
+  for s in $STEPS; do
+    fails=$(cat "/tmp/watcher_fail.$s" 2>/dev/null || echo 0)
+    [ -e "$(step_out "$s")" ] || [ "$fails" -ge "$MAXFAIL" ] \
+      || { next="$s"; break; }
+  done
+  [ -z "$next" ] && { echo "suite done $(date -u)" >> "$L"; exit 0; }
+  if probe; then
+    echo "tunnel alive $(date -u +%H:%M:%S), step $next" | tee -a "$L"
+    date -u >> "$R/tpu_alive_${ROUND}.marker"
+    run_step "$next"
+    if [ -e "$(step_out "$next")" ]; then
+      rm -f "/tmp/watcher_fail.$next"
+    elif probe; then
+      fails=$(cat "/tmp/watcher_fail.$next" 2>/dev/null || echo 0)
+      echo $((fails + 1)) > "/tmp/watcher_fail.$next"
+      echo "fail $((fails + 1))/$MAXFAIL for step $next (tunnel alive)" \
+        >> "$L"
+    fi
+  else
+    echo "tunnel dead $(date -u +%H:%M:%S), step $next pending" >> "$L"
+    sleep "$SLEEP"
+  fi
+done
+echo "tunnel never answered in $POLLS polls" >> "$L"
+exit 1

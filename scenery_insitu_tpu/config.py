@@ -205,6 +205,28 @@ class CompositeConfig:
     # itself always runs in f32. Quantized modes are lossy by contract
     # (tests hold them to PSNR floors).
     wire: str = "f32"
+    # Frame schedule (docs/PERF.md "Tile waves"):
+    #   "frame"  the whole frame is one march → one exchange → one
+    #            composite (the monolithic SPMD chain — exchange time
+    #            adds serially to march time);
+    #   "waves"  the column block (tile) is the unit of march, exchange,
+    #            composite and delivery: each rank marches one
+    #            column-block wave at a time and, while wave w+1
+    #            marches, wave w's fragments circulate and fold
+    #            (software-pipelined lax.scan with a double-buffered
+    #            fragment slot — XLA overlaps the collective with the
+    #            next wave's march inside one compiled step). Lossless
+    #            waves are parity-exact with the frame schedule; the
+    #            session can deliver finished column blocks before the
+    #            frame closes. Single-rank meshes degrade to "frame"
+    #            (ledgered) — there is nothing to overlap.
+    schedule: str = "frame"
+    # Column-block waves per rank-owned block under schedule="waves"
+    # (the frame is n_ranks * wave_tiles tiles). The intermediate width
+    # must divide by ranks * wave_tiles. More waves = finer overlap and
+    # lower tile-delivery latency, but each wave re-reads the volume's
+    # live chunks (march state is per-wave) — 2-8 is the useful range.
+    wave_tiles: int = 4
     # Per-rank supersegment budget of the sort-last fold (docs/PERF.md
     # "Empty-space skipping"):
     #   "static"     every rank's adaptive threshold targets the full K
@@ -231,6 +253,12 @@ class CompositeConfig:
         if self.wire not in ("f32", "bf16", "qpack8"):
             raise ValueError(f"wire must be 'f32', 'bf16' or 'qpack8', "
                              f"got {self.wire!r}")
+        if self.schedule not in ("frame", "waves"):
+            raise ValueError(f"schedule must be 'frame' or 'waves', "
+                             f"got {self.schedule!r}")
+        if self.wave_tiles < 1:
+            raise ValueError(f"wave_tiles must be >= 1, "
+                             f"got {self.wave_tiles}")
         if self.k_budget not in ("static", "occupancy"):
             raise ValueError(f"k_budget must be 'static' or 'occupancy', "
                              f"got {self.k_budget!r}")

@@ -134,7 +134,8 @@ def decompress(data: bytes, codec: str = "zstd") -> bytes:
 # ----------------------------------------------------------- file artifacts
 
 def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
-             codec: str = "zstd", precision: str = "f32") -> int:
+             codec: str = "zstd", precision: str = "f32",
+             tile: Optional[Tuple[int, int, int]] = None) -> int:
     """Write a VDI (+ metadata) as one .npz artifact; returns bytes written.
 
     The npz members are individually compressed with ``codec`` (numpy's own
@@ -148,6 +149,13 @@ def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
     recorded both in the artifact and in the metadata's ``precision``
     field so ``load_vdi`` dequantizes back to f32 transparently. Lossy by
     the wire contract — quantization error, not codec error.
+
+    ``tile=(index, total, col0)`` marks a PARTIAL-frame column-block
+    artifact (the tile-wave delivery unit, docs/PERF.md "Tile waves"):
+    this VDI holds columns [col0, col0 + width) of tile ``index`` of
+    ``total``. Read the placement back with ``load_vdi_tile``;
+    ``load_vdi`` ignores it (the buffers are a self-contained VDI either
+    way).
     """
     if precision not in ("f32", "qpack8"):
         raise ValueError(f"precision must be 'f32' or 'qpack8', "
@@ -176,6 +184,8 @@ def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
             # quantized hop (load_vdi / VDISubscriber keep the tag as
             # provenance) must not mislabel the f32 buffers written here
             meta = meta._replace(precision=np.int32(0))
+    if tile is not None:
+        members["__tile__"] = np.asarray(tile, np.int64)    # (idx, total, col0)
     if meta is not None:
         for f in _META_FIELDS:
             members[f"meta_{f}"] = np.asarray(getattr(meta, f))
@@ -230,6 +240,20 @@ def load_vdi(path: str) -> Tuple[VDI, Optional[VDIMetadata]]:
         else:
             meta = None
     return vdi, meta
+
+
+def load_vdi_tile(path: str) -> Tuple[VDI, Optional[VDIMetadata],
+                                      Optional[Tuple[int, int, int]]]:
+    """`load_vdi` plus the artifact's tile placement: returns (vdi, meta,
+    (tile_index, tiles_total, col0) or None for whole-frame artifacts).
+    The reassembly contract: concatenating the ``tiles_total`` tiles of
+    one frame along the width axis in tile order reproduces the frame
+    the waves schedule composited."""
+    vdi, meta = load_vdi(path)
+    with np.load(path) as z:
+        tile = (tuple(int(x) for x in z["__tile__"])
+                if "__tile__" in z else None)
+    return vdi, meta, tile
 
 
 # ------------------------------------------------- variable-length segments

@@ -208,7 +208,9 @@ def modeled_exchange_traffic(n: int, k: int, height: int, width: int,
                              k_out: Optional[int] = None,
                              mode: str = "all_to_all", ring_slots: int = 0,
                              itemsize: int = 4,
-                             wire: str = "f32") -> dict:
+                             wire: str = "f32",
+                             schedule: str = "frame",
+                             wave_tiles: int = 1) -> dict:
     """Modeled per-rank bytes of the sort-last exchange + composite for
     one frame — the composite counterpart of
     ``sim.pallas_stencil.modeled_sim_traffic`` (probe-free, usable
@@ -230,6 +232,17 @@ def modeled_exchange_traffic(n: int, k: int, height: int, width: int,
     working set PLUS the resegmented ``k_out``-slot output write, both in
     f32 ``itemsize`` — the composite always decodes to and folds in f32,
     so HBM stream bytes do not shrink with the wire.
+
+    ``schedule="waves"`` (+ ``wave_tiles``; docs/PERF.md "Tile waves")
+    adds the overlap accounting of the tile-wave pipeline: total wire
+    bytes are unchanged (every fragment still crosses ICI once), but the
+    exchange is issued per column-block wave and each wave's collective
+    flies while the NEXT wave marches — so the bytes of waves 0..T-2 are
+    hidden behind march compute and only the LAST wave's exchange (plus
+    wave 0's march) stays exposed on the critical path:
+    ``ici_bytes_hidden_per_rank = (T-1)/T`` of the total, and the
+    per-pixel merge working set is unchanged (waves split columns, not
+    slots).
     """
     from scenery_insitu_tpu.ops.wire import wire_slot_bytes
 
@@ -241,16 +254,35 @@ def modeled_exchange_traffic(n: int, k: int, height: int, width: int,
         slots = min(int(ring_slots), n * k) + k
     else:
         slots = n * k
-    return {
+    out = {
         "mode": mode, "ranks": n, "k": k,
         "k_out": k_out, "ring_slots": ring_slots,
         "wire": wire,
+        "schedule": schedule,
         "wire_color_bytes_per_slot": cb,
         "wire_depth_bytes_per_slot": db,
         "ici_bytes_per_rank": (n - 1) * frag,
         "peak_stream_slots_per_pixel": slots,
         "stream_bytes_per_rank": (slots + (k_out or 0)) * height * wb * seg,
     }
+    if schedule == "waves":
+        t = max(int(wave_tiles), 1)
+        # split the TOTAL so hidden + exposed always equals
+        # ici_bytes_per_rank — a tiling the pipeline would reject
+        # (wb % t != 0) still yields a self-consistent model, with the
+        # remainder charged to the exposed (last) wave
+        total = out["ici_bytes_per_rank"]
+        per_wave = total // t
+        hidden = (t - 1) * per_wave
+        out["wave_tiles"] = t
+        out["ici_bytes_per_wave_per_rank"] = per_wave
+        # waves 0..T-2 circulate while wave 1..T-1 march; the last wave's
+        # exchange has no next march to hide behind
+        out["ici_bytes_hidden_per_rank"] = hidden
+        out["ici_bytes_exposed_per_rank"] = total - hidden
+        out["overlap_hidden_frac"] = round(hidden / total, 4) if total \
+            else 0.0
+    return out
 
 
 def composite_plain(images: jnp.ndarray, depths: jnp.ndarray,

@@ -363,6 +363,80 @@ def make_axis_camera(vol: Volume, cam: Camera, spec: AxisSpec,
                       far=far)
 
 
+# ------------------------------------------------------------- tile waves
+
+
+def wave_block(ni: int, n_ranks: int, wave_tiles: int) -> int:
+    """Column width of one tile wave's per-rank block: the intermediate
+    width splits into ``n_ranks`` rank-owned blocks, each into
+    ``wave_tiles`` tiles (docs/PERF.md "Tile waves"). Raises when the
+    geometry does not divide — the wave schedule needs exact blocks."""
+    if ni % (n_ranks * wave_tiles):
+        raise ValueError(
+            f"intermediate width {ni} not divisible by ranks*wave_tiles "
+            f"= {n_ranks}*{wave_tiles} (pick wave_tiles so every rank's "
+            f"{ni // n_ranks if n_ranks and ni % n_ranks == 0 else ni}"
+            f"-column block splits evenly)")
+    return ni // (n_ranks * wave_tiles)
+
+
+def wave_cols(x: jnp.ndarray, n_ranks: int, wave_tiles: int, w):
+    """Slice the trailing (width) axis of ``x [..., Ni]`` to tile wave
+    ``w``'s columns: for each of the ``n_ranks`` rank-owned blocks, the
+    w-th of ``wave_tiles`` sub-tiles → ``[..., n_ranks * wb]``. ``w``
+    may be traced (the wave scan's induction variable)."""
+    ni = x.shape[-1]
+    wb = wave_block(ni, n_ranks, wave_tiles)
+    # reshaped dims: x.shape[:-1] + (n_ranks @ x.ndim-1, T @ x.ndim, wb)
+    g = x.reshape(x.shape[:-1] + (n_ranks, wave_tiles, wb))
+    g = jax.lax.dynamic_index_in_dim(g, w, axis=x.ndim, keepdims=False)
+    return g.reshape(x.shape[:-1] + (n_ranks * wb,))
+
+
+def wave_update_cols(x: jnp.ndarray, xw: jnp.ndarray, n_ranks: int,
+                     wave_tiles: int, w) -> jnp.ndarray:
+    """Inverse of `wave_cols`: scatter wave ``w``'s columns ``xw
+    [..., n_ranks * wb]`` back into ``x [..., Ni]`` (the temporal
+    threshold maps update only the wave they marched)."""
+    ni = x.shape[-1]
+    wb = wave_block(ni, n_ranks, wave_tiles)
+    g = x.reshape(x.shape[:-1] + (n_ranks, wave_tiles, wb))
+    upd = xw.reshape(xw.shape[:-1] + (n_ranks, 1, wb))
+    g = jax.lax.dynamic_update_index_in_dim(g, upd, w, axis=x.ndim)
+    return g.reshape(x.shape)
+
+
+def wave_camera(axcam: AxisCamera, spec: AxisSpec, n_ranks: int,
+                wave_tiles: int, w) -> Tuple[AxisCamera, AxisSpec]:
+    """Column-sliced (AxisCamera, AxisSpec) of tile wave ``w``.
+
+    Every virtual-camera column is an independent ray fan (the banded
+    resampling matrices are built per output column from ``u_grid``), so
+    marching a subset of columns is exactly the column slice of the full
+    march — the wave camera just carries wave ``w``'s ``n_ranks * wb``
+    u-grid entries (one ``wb``-wide tile per rank-owned block, so the
+    sliced frame still splits into n rank blocks for the sort-last
+    exchange). The spec's ``ni`` shrinks to match; everything else
+    (march axis, chunking, fold, occupancy gating — all u-independent)
+    is reused, as are the frame's one ``permute_volume`` copy and
+    occupancy pyramid. ``w`` may be traced."""
+    ug = wave_cols(axcam.u_grid, n_ranks, wave_tiles, w)
+    return (axcam._replace(u_grid=ug),
+            dataclasses.replace(spec, ni=ug.shape[-1]))
+
+
+def slice_march_wave(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
+                     spec: AxisSpec, consume: Callable, carry0,
+                     n_ranks: int, wave_tiles: int, w, **kwargs):
+    """Tile-scoped `slice_march`: march only tile wave ``w``'s column
+    blocks (docs/PERF.md "Tile waves"). Accepts every `slice_march`
+    keyword — pass the frame's shared ``volp`` (permute_volume copy) and
+    ``occupancy`` (the per-frame pyramid gate, u-independent) so T waves
+    cost one permuted copy and one pyramid, not T."""
+    axcam_w, spec_w = wave_camera(axcam, spec, n_ranks, wave_tiles, w)
+    return slice_march(vol, tf, axcam_w, spec_w, consume, carry0, **kwargs)
+
+
 # ------------------------------------------------------------------ march
 
 
@@ -846,7 +920,8 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                   spec: AxisSpec, early_exit_alpha: float = 0.999,
                   u_bounds=None, v_bounds=None,
                   step_scale: float = 1.0,
-                  occupancy=None) -> RaycastOutput:
+                  occupancy=None,
+                  volp: Optional[jnp.ndarray] = None) -> RaycastOutput:
     """Front-to-back alpha-under accumulation on the intermediate grid
     (≅ VolumeRaycaster.comp, but slice-order). Background-free premultiplied
     image + first-hit depth (ray parameter; +inf where empty). Skips
@@ -886,7 +961,8 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
 
     acc0 = jnp.zeros((4, spec.nj, spec.ni), jnp.float32)
     t0 = jnp.full((spec.nj, spec.ni), jnp.inf, jnp.float32)
-    volp = permute_volume(vol, spec)
+    if volp is None:
+        volp = permute_volume(vol, spec)
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     acc, first_t = slice_march(vol, tf, axcam, spec, consume, (acc0, t0),
                                u_bounds, v_bounds, step_scale,
@@ -988,6 +1064,8 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
                      box_max: Optional[jnp.ndarray] = None,
                      u_bounds=None, v_bounds=None,
                      occupancy=None, k_target=None,
+                     axcam: Optional[AxisCamera] = None,
+                     volp: Optional[jnp.ndarray] = None,
                      ) -> Tuple[VDI, VDIMetadata, AxisCamera]:
     """VDI generation on the MXU slice march (≅ VDIGenerator.comp +
     AccumulateVDI.comp, see ops.vdi_gen for the gather-path equivalent).
@@ -1003,16 +1081,23 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     rebuilds from the volume here. ``k_target`` (traced scalar or
     [nj, ni]) re-targets the adaptive threshold at fewer than
     ``cfg.max_supersegments`` segments — output SHAPES stay at K; this is
-    the load-aware K budget hook (occupancy.k_budget_target)."""
+    the load-aware K budget hook (occupancy.k_budget_target).
+
+    ``axcam`` overrides the virtual camera (the tile-wave path passes a
+    column-sliced `wave_camera` whose u_grid matches ``spec.ni``);
+    ``volp`` shares a pre-built `permute_volume` copy across calls (T
+    waves march the same frame copy)."""
     cfg = cfg or VDIConfig()
     k = cfg.max_supersegments
     kt = k if k_target is None else k_target
     nj, ni = spec.nj, spec.ni
-    axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
+    if axcam is None:
+        axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
 
     # ONE permuted copy + one occupancy structure shared by every
     # counting + writing march of this generation
-    volp = permute_volume(vol, spec)
+    if volp is None:
+        volp = permute_volume(vol, spec)
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
@@ -1167,6 +1252,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
                               box_max: Optional[jnp.ndarray] = None,
                               u_bounds=None, v_bounds=None,
                               occupancy=None, k_target=None,
+                              axcam: Optional[AxisCamera] = None,
+                              volp: Optional[jnp.ndarray] = None,
                               ) -> Tuple[VDI, VDIMetadata, AxisCamera,
                                          ss.ThresholdState]:
     """VDI generation with ONE march per frame (adaptive_mode="temporal").
@@ -1184,17 +1271,21 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
     overflow merges into the last slot — the same graceful degradation
     every mode shares) and corrected over the following frames.
 
-    ``occupancy``/``k_target``: see `generate_vdi_mxu` — the controller
-    bisects toward ``k_target`` (the occupancy K budget) instead of K
-    when given; output shapes stay at K.
+    ``occupancy``/``k_target``/``axcam``/``volp``: see
+    `generate_vdi_mxu` — the controller bisects toward ``k_target`` (the
+    occupancy K budget) instead of K when given; output shapes stay at
+    K; the tile-wave path passes a column-sliced camera, the shared
+    frame copy, and column-sliced threshold maps.
     """
     cfg = cfg or VDIConfig()
     k = cfg.max_supersegments
     kt = k if k_target is None else k_target
     nj, ni = spec.nj, spec.ni
     thr = threshold.thr
-    axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
-    volp = permute_volume(vol, spec)
+    if axcam is None:
+        axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
+    if volp is None:
+        volp = permute_volume(vol, spec)
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
 
     if spec.fold == "pallas":

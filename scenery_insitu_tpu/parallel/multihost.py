@@ -73,10 +73,34 @@ def shard_global(local_block: np.ndarray, mesh, axis_name: str = DEFAULT_AXIS
     return jax.make_array_from_process_local_data(sharding, local_block)
 
 
-def gather_vdi_compressed(vdi, codec: str = "zstd"
-                          ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Host hop: compress each process's addressable output columns and
-    assemble the full (color, depth) on process 0 (returns None elsewhere).
+def _allgather_blobs(blob: bytes):
+    """Padded-uint8 allgather of one variable-length blob per process:
+    returns (blobs [P, 1, maxlen], lengths [P, 1]) — the shared
+    transport of the compressed VDI gather and the obs-event merge."""
+    from jax.experimental import multihost_utils
+
+    ln = np.zeros((1,), np.int64)
+    ln[0] = len(blob)
+    # normalize to [P, 1] / [P, 1, maxlen]: single-process allgather
+    # returns the input without a leading process axis
+    lengths = np.asarray(
+        multihost_utils.process_allgather(ln)).reshape(-1, 1)
+    maxlen = int(lengths.max())
+    buf = np.zeros((1, maxlen), np.uint8)
+    buf[0, :len(blob)] = np.frombuffer(blob, np.uint8)
+    blobs = np.asarray(
+        multihost_utils.process_allgather(buf)).reshape(-1, 1, maxlen)
+    return blobs, lengths
+
+
+def gather_vdi_tiles(vdi, codec: str = "zstd"):
+    """Tile-granular host gather (docs/PERF.md "Tile waves"): compress
+    each process's addressable column block and, on process 0, YIELD the
+    blocks as ``(col0, color, depth)`` in ascending column order, each
+    decompressed lazily as the consumer reaches it — rank-0 assembly
+    (and anything it feeds, e.g. a VDIPublisher publishing tiles) can
+    emit the first columns before the whole frame finishes
+    decompressing. Returns a generator on process 0, None elsewhere.
 
     Wire format: one dense zstd/zlib blob per process (its contiguous
     column block: raw color bytes + depth bytes) with per-process byte
@@ -86,7 +110,6 @@ def gather_vdi_compressed(vdi, codec: str = "zstd"
     already happened on-device; only the final gather crosses hosts).
     Transport is jax's process_allgather on a padded uint8 buffer."""
     import jax
-    from jax.experimental import multihost_utils
 
     from scenery_insitu_tpu.io.vdi_io import compress, decompress
 
@@ -100,30 +123,43 @@ def gather_vdi_compressed(vdi, codec: str = "zstd"
         key=lambda s: s.index[-1].start or 0)
     local_c = np.concatenate([np.asarray(s.data) for s in col_shards], -1)
     local_d = np.concatenate([np.asarray(s.data) for s in dep_shards], -1)
-    blob = compress(local_c.tobytes() + local_d.tobytes(), codec)
-
-    # pad to the max blob length and allgather (+ lengths)
-    nproc = jax.process_count()
-    ln = np.zeros((1,), np.int64)
-    ln[0] = len(blob)
-    lengths = multihost_utils.process_allgather(ln)          # [P, 1]
-    maxlen = int(lengths.max())
-    buf = np.zeros((1, maxlen), np.uint8)
-    buf[0, :len(blob)] = np.frombuffer(blob, np.uint8)
-    blobs = multihost_utils.process_allgather(buf)           # [P, 1, maxlen]
+    blobs, lengths = _allgather_blobs(
+        compress(local_c.tobytes() + local_d.tobytes(), codec))
 
     if jax.process_index() != 0:
         return None
+    nproc = jax.process_count()
     k, ch, h, _ = vdi.color.shape
-    _, ch_d = vdi.depth.shape[0], vdi.depth.shape[1]
+    ch_d = vdi.depth.shape[1]
+
+    def tiles():
+        col0 = 0
+        for p in range(nproc):
+            raw = decompress(bytes(blobs[p, 0, :int(lengths[p, 0])]),
+                             codec)
+            arr = np.frombuffer(raw, np.float32)
+            wseg = arr.size // (k * (ch + ch_d) * h)
+            nc = k * ch * h * wseg
+            yield (col0, arr[:nc].reshape(k, ch, h, wseg),
+                   arr[nc:].reshape(k, ch_d, h, wseg))
+            col0 += wseg
+
+    return tiles()
+
+
+def gather_vdi_compressed(vdi, codec: str = "zstd"
+                          ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Host hop: compress each process's addressable output columns and
+    assemble the full (color, depth) on process 0 (returns None
+    elsewhere). The whole-frame view of `gather_vdi_tiles` — same wire
+    format and transport, blocks concatenated in column order."""
+    tiles = gather_vdi_tiles(vdi, codec)
+    if tiles is None:
+        return None
     cols, deps = [], []
-    for p in range(nproc):
-        raw = decompress(bytes(blobs[p, 0, :int(lengths[p, 0])]), codec)
-        arr = np.frombuffer(raw, np.float32)
-        wseg = arr.size // (k * (ch + ch_d) * h)
-        nc = k * ch * h * wseg
-        cols.append(arr[:nc].reshape(k, ch, h, wseg))
-        deps.append(arr[nc:].reshape(k, ch_d, h, wseg))
+    for _, c, d in tiles:
+        cols.append(c)
+        deps.append(d)
     return np.concatenate(cols, -1), np.concatenate(deps, -1)
 
 
@@ -151,16 +187,8 @@ def gather_obs_events(recorder) -> Optional[list]:
         return sorted(payload["events"], key=lambda e: e.get("ts", 0.0)) \
             + [{"type": "summary", **payload["summary"]}]
 
-    from jax.experimental import multihost_utils
-
-    blob = zlib.compress(_json.dumps(payload).encode())
-    ln = np.zeros((1,), np.int64)
-    ln[0] = len(blob)
-    lengths = multihost_utils.process_allgather(ln)
-    maxlen = int(lengths.max())
-    buf = np.zeros((1, maxlen), np.uint8)
-    buf[0, :len(blob)] = np.frombuffer(blob, np.uint8)
-    blobs = multihost_utils.process_allgather(buf)
+    blobs, lengths = _allgather_blobs(
+        zlib.compress(_json.dumps(payload).encode()))
 
     if jax.process_index() != 0:
         return None

@@ -34,6 +34,24 @@ and decoded right after it — ``f32`` (bit-exact), ``bf16`` (12 B/slot,
 2×) or ``qpack8`` (u8 color + u8×2 depth against per-fragment [near,
 far] scalars, 6 B/slot, 4×). The merge/composite always runs in f32.
 
+A third axis, ``CompositeConfig.schedule`` (docs/PERF.md "Tile waves"),
+sets the GRANULARITY of the whole chain: ``"frame"`` runs one march →
+one exchange → one composite per frame (exchange time adds serially to
+march time), while ``"waves"`` makes the column block (tile) the unit of
+march, exchange, composite and delivery — each rank marches one
+column-block wave at a time (`ops.slicer.wave_camera` slices the virtual
+camera's u grid; the frame's one `permute_volume` copy and occupancy
+pyramid are shared by every wave) and, while wave w+1 marches, wave w's
+fragments circulate and fold: a software-pipelined ``lax.scan`` over
+waves holds the previous wave's fragments in a double-buffered carry
+slot, so XLA schedules the collective (ring ``ppermute`` chain or
+per-wave ``all_to_all``, per ``exchange``) concurrently with the next
+wave's resampling matmuls inside ONE compiled step. Lossless waves are
+parity-exact with the frame schedule (same per-pixel fragments, same
+merge order), and the per-wave outputs land in the same W-sharded layout
+— plus the session can deliver finished column blocks to subscribers
+before the frame closes (runtime/session.py tile sinks).
+
 Decomposition is 1-D over the volume z axis with one-voxel halo exchange,
 making distributed trilinear sampling seam-exact vs a single-device render
 (tests assert PSNR, test_parallel.py).
@@ -247,6 +265,144 @@ def _composite_exchanged(color: jnp.ndarray, depth: jnp.ndarray,
     return composite_vdis(colors, depths, comp_cfg)
 
 
+# ------------------------------------------------------------- tile waves
+
+
+def _wave_pipeline(n_waves: int, march_wave, compose, carry0=None):
+    """Software-pipelined scan over tile waves (docs/PERF.md "Tile
+    waves"): iteration w exchanges+composites wave w-1's fragments (held
+    in the double-buffered carry slot) while marching wave w — the two
+    are data-independent inside one scan body, so XLA overlaps the
+    collective with the next wave's march.
+
+    ``march_wave(w, carry) -> (fragments, carry')`` produces wave ``w``'s
+    pre-exchange fragments (any pytree) plus carried per-wave state (the
+    temporal threshold maps; None when stateless). ``compose(fragments)
+    -> out`` runs the exchange + composite of one wave. Returns (outs
+    stacked on a leading wave axis, final carry). The prologue marches
+    wave 0 and the epilogue composites wave T-1, so every wave is
+    composited exactly once."""
+    frag, carry = march_wave(jnp.int32(0), carry0)
+
+    def body(c, w):
+        fr, cr = c
+        out = compose(fr)                  # wave w-1 circulates ...
+        fr2, cr = march_wave(w, cr)        # ... while wave w marches
+        return (fr2, cr), out
+
+    (frag, carry), outs = jax.lax.scan(body, (frag, carry),
+                                       jnp.arange(1, n_waves))
+    last = compose(frag)
+    outs = jax.tree_util.tree_map(
+        lambda s, l: jnp.concatenate([s, l[None]], axis=0), outs, last)
+    return outs, carry
+
+
+def _wave_assemble(x: jnp.ndarray) -> jnp.ndarray:
+    """[T, ..., wb] per-wave tiles -> [..., T*wb]: wave w's tile is the
+    w-th sub-block of this rank's contiguous owned column block, so
+    concatenating along waves reproduces EXACTLY the frame schedule's
+    output layout (W-sharded, rank blocks contiguous)."""
+    t = x.shape[0]
+    moved = jnp.moveaxis(x, 0, -2)                    # [..., T, wb]
+    return moved.reshape(moved.shape[:-2] + (t * moved.shape[-1],))
+
+
+def _wave_build_marker(n: int, t: int, k: int, h: int, w: int, k_out: int,
+                       exchange: str, ring_slots: int, wire: str,
+                       marched: bool) -> None:
+    """Host-side trace-time marker of one wave-schedule build
+    (docs/OBSERVABILITY.md): counters for the build and its T waves plus
+    one event carrying the modeled overlap accounting — what fraction of
+    the exchange bytes the pipeline hides behind march compute.
+    ``marched=False`` tags the monolithic-march variant (gather/plain
+    engines pipeline exchange+composite only)."""
+    from scenery_insitu_tpu import obs as _obs
+    from scenery_insitu_tpu.ops.composite import modeled_exchange_traffic
+
+    rec = _obs.get_recorder()
+    rec.count("wave_schedule_builds")
+    rec.count("wave_steps_built", t)
+    rec.event("wave_schedule_build", ranks=n, tiles=t, k=k,
+              wave_cols=w // t, tile_cols=w // (n * t),
+              march_per_wave=marched,
+              traffic=modeled_exchange_traffic(
+                  n, k, h, w, k_out=k_out, mode=exchange,
+                  ring_slots=ring_slots, wire=wire,
+                  schedule="waves", wave_tiles=t))
+
+
+def _composite_exchanged_waves(color: jnp.ndarray, depth: jnp.ndarray,
+                               n: int, axis_name: str, comp_cfg) -> VDI:
+    """Tile-wave exchange + composite of an ALREADY-generated full-frame
+    fragment (the gather-engine waves path — the march was monolithic,
+    so the pipeline overlaps each wave's collective with the next wave's
+    merge+resegment instead of with march compute). Per wave: slice the
+    wave's column blocks, run the frame compositor on them
+    (`_composite_exchanged` — ring or all_to_all per ``exchange``), and
+    reassemble; per-pixel identical to the frame schedule."""
+    from scenery_insitu_tpu.ops import slicer as _slicer
+
+    t = comp_cfg.wave_tiles
+    k = color.shape[0]
+    h, w = color.shape[-2], color.shape[-1]
+    _slicer.wave_block(w, n, t)            # validates the geometry
+    _wave_build_marker(n, t, k, h, w, comp_cfg.max_output_supersegments,
+                       comp_cfg.exchange, comp_cfg.ring_slots,
+                       comp_cfg.wire, marched=False)
+
+    def march(wv, _):
+        return (_slicer.wave_cols(color, n, t, wv),
+                _slicer.wave_cols(depth, n, t, wv)), None
+
+    def compose(fr):
+        out = _composite_exchanged(fr[0], fr[1], n, axis_name, comp_cfg)
+        return out.color, out.depth
+
+    (oc, od), _ = _wave_pipeline(t, march, compose)
+    return VDI(_wave_assemble(oc), _wave_assemble(od))
+
+
+def _composite_exchanged_sched(color: jnp.ndarray, depth: jnp.ndarray,
+                               n: int, axis_name: str, comp_cfg) -> VDI:
+    """Schedule dispatcher of the sort-last exchange + composite
+    (CompositeConfig.schedule): "frame" = the monolithic chain above,
+    "waves" = the per-column-block-wave scan. A single-rank mesh
+    degrades waves -> frame on the ledger — there is no exchange to
+    pipeline and the frame path keeps the single-VDI fast path."""
+    if comp_cfg.schedule == "waves":
+        if n > 1:
+            return _composite_exchanged_waves(color, depth, n, axis_name,
+                                              comp_cfg)
+        from scenery_insitu_tpu import obs as _obs
+
+        _obs.degrade("composite.schedule", "waves", "frame",
+                     "single-rank mesh has no exchange to pipeline",
+                     warn=False)
+    return _composite_exchanged(color, depth, n, axis_name, comp_cfg)
+
+
+def _resolve_waves(comp_cfg, n: int, width: int, slicer_mod=None) -> bool:
+    """Build-time resolution of CompositeConfig.schedule for a step
+    builder: True = run the tile-wave path (validating that ``width``
+    splits into ranks * wave_tiles blocks — a bad geometry fails at
+    build, not trace), False = frame path. A waves request on a
+    single-rank mesh lands on the ledger (nothing to pipeline)."""
+    if comp_cfg.schedule != "waves":
+        return False
+    if n == 1:
+        from scenery_insitu_tpu import obs as _obs
+
+        _obs.degrade("composite.schedule", "waves", "frame",
+                     "single-rank mesh has no exchange to pipeline",
+                     warn=False)
+        return False
+    if slicer_mod is None:
+        from scenery_insitu_tpu.ops import slicer as slicer_mod
+    slicer_mod.wave_block(width, n, comp_cfg.wave_tiles)
+    return True
+
+
 def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
                          n: int, axis_name: str, wire: str = "f32"):
     """Ring schedule for the plain-image exchange: n-1 single-fragment
@@ -318,6 +474,39 @@ def _composite_plain_exchanged(image: jnp.ndarray, depth: jnp.ndarray,
     return composite_plain(images, depths, background)
 
 
+def _composite_plain_waves(image: jnp.ndarray, depth: jnp.ndarray,
+                           n: int, axis_name: str, background,
+                           exchange: str, wire: str, wave_tiles: int,
+                           march_wave=None) -> jnp.ndarray:
+    """Tile-wave plain-image exchange + composite. ``march_wave(w, _) ->
+    ((image_w, depth_w), _)`` optionally RENDERS each wave's column
+    blocks (the MXU engine's tile-scoped `render_slices`) so the wave's
+    collective overlaps the next wave's march; None slices pre-rendered
+    full-frame fragments (the gather engine — exchange/composite
+    pipelining only). Output layout == the frame schedule's."""
+    from scenery_insitu_tpu.ops import slicer as _slicer
+
+    t = wave_tiles
+    w = image.shape[-1] if march_wave is None else None
+
+    def slice_wave(wv, _):
+        return (_slicer.wave_cols(image, n, t, wv),
+                _slicer.wave_cols(depth, n, t, wv)), None
+
+    if march_wave is None:
+        _slicer.wave_block(w, n, t)
+        _wave_build_marker(n, t, 1, image.shape[-2], w, 1, exchange, 0,
+                           wire, marched=False)
+        march_wave = slice_wave
+
+    def compose(fr):
+        return (_composite_plain_exchanged(fr[0], fr[1], n, axis_name,
+                                           background, exchange, wire),)
+
+    (img,), _ = _wave_pipeline(t, march_wave, compose)
+    return _wave_assemble(img)
+
+
 def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
                          width: int, height: int,
                          vdi_cfg: Optional[VDIConfig] = None,
@@ -336,6 +525,10 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
     n = mesh.shape[axis]
     if width % n:
         raise ValueError(f"width {width} not divisible by mesh size {n}")
+    if comp_cfg.schedule == "waves" and n > 1:
+        from scenery_insitu_tpu.ops.slicer import wave_block
+
+        wave_block(width, n, comp_cfg.wave_tiles)   # fail at build time
     if comp_cfg.k_budget == "occupancy":
         # the gather engine has no occupancy pyramid to derive budgets
         # from — a configured-but-inert knob must land on the ledger
@@ -352,7 +545,8 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
         vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
                               max_steps=max_steps, clip_min=cmin,
                               clip_max=cmax)
-        return _composite_exchanged(vdi.color, vdi.depth, n, axis, comp_cfg)
+        return _composite_exchanged_sched(vdi.color, vdi.depth, n, axis,
+                                          comp_cfg)
 
     spec_vol = P(axis, None, None)
     spec_out = VDI(P(None, None, None, axis), P(None, None, None, axis))
@@ -430,23 +624,15 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
     return vol, gmax, v_bounds, (w, h, dn * n)
 
 
-def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
-                       tf, vdi_cfg, axis, n, threshold=None,
-                       comp_cfg=None):
-    """Per-rank slice-march VDI generation on a z-slab (shared by the
-    distributed VDI and hybrid steps). Returns (vdi, meta, axcam,
-    next_threshold) — the last is None unless carried temporal threshold
-    state was passed in.
-
-    This is where the frame's ONE occupancy pyramid is built
-    (ops/occupancy.pyramid_from_volume on the halo-exact slab) and
-    shared by every march of the generation — the legacy path re-ran the
-    permute + full-slab reduction per call site. The same pyramid's live
-    fraction drives the load-aware per-rank K budget when
-    ``comp_cfg.k_budget == "occupancy"``: a psum over the mesh turns the
-    per-rank live fractions into shares of the N*K budget
-    (occupancy.k_budget_target), so the adaptive threshold on a sparse
-    slab stops chasing the same K as the densest rank."""
+def _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
+                      axis, n, comp_cfg):
+    """Per-frame, per-rank shared state of an MXU generation: the
+    halo-exact slab, the frame's ONE occupancy pyramid, and (when
+    ``comp_cfg.k_budget == "occupancy"``) the psum-derived adaptive-K
+    target. Shared by the frame-schedule generation
+    (`_mxu_rank_generate`) and the tile-wave path
+    (`_mxu_rank_generate_waves`) — T waves must not pay T pyramids or T
+    psums."""
     vol, gmax, v_bounds, dims = _rank_slab(local_data, origin, spacing,
                                            spec, axis, n)
     occ_pyr = None
@@ -478,6 +664,28 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
         rec.event("occupancy_kbudget_build", ranks=n,
                   k=vdi_cfg.max_supersegments,
                   k_min=comp_cfg.k_budget_min)
+    return vol, gmax, v_bounds, dims, occ_pyr, k_target
+
+
+def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
+                       tf, vdi_cfg, axis, n, threshold=None,
+                       comp_cfg=None):
+    """Per-rank slice-march VDI generation on a z-slab (shared by the
+    distributed VDI and hybrid steps). Returns (vdi, meta, axcam,
+    next_threshold) — the last is None unless carried temporal threshold
+    state was passed in.
+
+    This is where the frame's ONE occupancy pyramid is built
+    (ops/occupancy.pyramid_from_volume on the halo-exact slab) and
+    shared by every march of the generation — the legacy path re-ran the
+    permute + full-slab reduction per call site. The same pyramid's live
+    fraction drives the load-aware per-rank K budget when
+    ``comp_cfg.k_budget == "occupancy"``: a psum over the mesh turns the
+    per-rank live fractions into shares of the N*K budget
+    (occupancy.k_budget_target), so the adaptive threshold on a sparse
+    slab stops chasing the same K as the densest rank."""
+    vol, gmax, v_bounds, dims, occ_pyr, k_target = _rank_frame_state(
+        local_data, origin, spacing, spec, tf, vdi_cfg, axis, n, comp_cfg)
     if threshold is None:
         vdi, meta, axcam = slicer.generate_vdi_mxu(
             vol, tf, cam, spec, vdi_cfg,
@@ -490,6 +698,70 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
             box_min=origin, box_max=gmax, v_bounds=v_bounds,
             occupancy=occ_pyr, k_target=k_target)
     # metadata must describe the GLOBAL volume, not this rank's slab
+    meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
+    return vdi, meta, axcam, thr2
+
+
+def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
+                             spec, tf, vdi_cfg, comp_cfg, axis, n,
+                             threshold=None):
+    """The tile-wave twin of `_mxu_rank_generate` + `_composite_exchanged`
+    (CompositeConfig.schedule == "waves"; docs/PERF.md "Tile waves"):
+    instead of one whole-frame march followed by one exchange, each rank
+    marches ONE column-block wave at a time (a tile-scoped generation on
+    `slicer.wave_camera`'s u-sliced virtual camera — same slices, same
+    per-pixel samples) and, while wave w+1 marches, wave w's fragments
+    circulate and fold through the frame compositor. The slab, the halo
+    exchange, the `permute_volume` copy, the occupancy pyramid and the
+    occupancy K budget are all built ONCE per frame and shared by every
+    wave.
+
+    Temporal mode slices the carried threshold maps to each wave's
+    columns and scatters the controller's update back — the full-frame
+    state that crosses frames is bit-identical in meaning to the frame
+    schedule's (each pixel is marched exactly once per frame either
+    way). Returns (vdi [K_out over this rank's contiguous column
+    block], meta, axcam, thr')."""
+    import jax.tree_util as jtu
+
+    vol, gmax, v_bounds, dims, occ_pyr, k_target = _rank_frame_state(
+        local_data, origin, spacing, spec, tf, vdi_cfg, axis, n, comp_cfg)
+    t = comp_cfg.wave_tiles
+    slicer.wave_block(spec.ni, n, t)       # validates the geometry
+    axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
+                                    box_max=gmax)
+    volp = slicer.permute_volume(vol, spec)
+    _wave_build_marker(n, t, vdi_cfg.max_supersegments, spec.nj, spec.ni,
+                       comp_cfg.max_output_supersegments,
+                       comp_cfg.exchange, comp_cfg.ring_slots,
+                       comp_cfg.wire, marched=True)
+
+    def march_wave(w, thr_full):
+        axcam_w, spec_w = slicer.wave_camera(axcam, spec, n, t, w)
+        if thr_full is None:
+            vdi, _, _ = slicer.generate_vdi_mxu(
+                vol, tf, cam, spec_w, vdi_cfg, v_bounds=v_bounds,
+                occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
+                volp=volp)
+            return (vdi.color, vdi.depth), None
+        thr_w = jtu.tree_map(lambda m: slicer.wave_cols(m, n, t, w),
+                             thr_full)
+        vdi, _, _, thr2w = slicer.generate_vdi_mxu_temporal(
+            vol, tf, cam, spec_w, thr_w, vdi_cfg, v_bounds=v_bounds,
+            occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
+            volp=volp)
+        thr_full = jtu.tree_map(
+            lambda m, mw: slicer.wave_update_cols(m, mw, n, t, w),
+            thr_full, thr2w)
+        return (vdi.color, vdi.depth), thr_full
+
+    def compose(fr):
+        out = _composite_exchanged(fr[0], fr[1], n, axis, comp_cfg)
+        return out.color, out.depth
+
+    (oc, od), thr2 = _wave_pipeline(t, march_wave, compose, threshold)
+    vdi = VDI(_wave_assemble(oc), _wave_assemble(od))
+    meta = slicer._vdi_meta(vol, axcam, spec.ni, spec.nj, 0)
     meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
     return vdi, meta, axcam, thr2
 
@@ -532,8 +804,14 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
     if spec.ni % n:
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
+    waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
 
     def body(local_data, origin, spacing, cam, thr):
+        if waves:
+            out, meta, _, thr2 = _mxu_rank_generate_waves(
+                local_data, origin, spacing, cam, slicer, spec, tf,
+                vdi_cfg, comp_cfg, axis, n, threshold=thr)
+            return out, meta, thr2
         vdi, meta, _, thr2 = _mxu_rank_generate(local_data, origin,
                                                 spacing, cam, slicer, spec,
                                                 tf, vdi_cfg, axis, n,
@@ -664,13 +942,23 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
     if spec.ni % n:
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
+    waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
 
     def body(local_data, origin, spacing, tr_pos, tr_vel, cam, thr):
-        vdi, meta, axcam, thr2 = _mxu_rank_generate(
-            local_data, origin, spacing, cam, slicer, spec, tf, vdi_cfg,
-            axis, n, threshold=thr, comp_cfg=comp_cfg)
-        comp = _composite_exchanged(vdi.color, vdi.depth, n, axis,
-                                    comp_cfg)              # [Ko,·,Nj,Ni/n]
+        if waves:
+            # the VDI half runs at tile-wave granularity; the splat half
+            # is per-frame (particles are sort-first, exchange-free) and
+            # inserts into the ASSEMBLED contiguous column block — the
+            # same block the frame schedule composites
+            comp, meta, axcam, thr2 = _mxu_rank_generate_waves(
+                local_data, origin, spacing, cam, slicer, spec, tf,
+                vdi_cfg, comp_cfg, axis, n, threshold=thr)
+        else:
+            vdi, meta, axcam, thr2 = _mxu_rank_generate(
+                local_data, origin, spacing, cam, slicer, spec, tf,
+                vdi_cfg, axis, n, threshold=thr, comp_cfg=comp_cfg)
+            comp = _composite_exchanged(vdi.color, vdi.depth, n, axis,
+                                        comp_cfg)          # [Ko,·,Nj,Ni/n]
 
         # sort-first particle pass on the virtual camera's rays
         sp = sort_first_splat(tr_pos, tr_vel, axis, spec.ni, spec.nj,
@@ -718,7 +1006,9 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                spec, cfg: Optional[RenderConfig] = None,
                                axis_name: Optional[str] = None,
                                exchange: str = "all_to_all",
-                               wire: str = "f32"):
+                               wire: str = "f32",
+                               schedule: str = "frame",
+                               wave_tiles: int = 4):
     """Distributed plain-image rendering on the MXU slice-march engine —
     the TPU-fast counterpart of `distributed_plain_step` (the reference's
     non-VDI mode, VolumeRaycaster.comp:94-161 composited by
@@ -742,7 +1032,11 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
     formats"; lossy modes quantize the exchanged RGBA+depth only, the
     composite runs in f32). Plain steps take both knobs directly because
     they carry no CompositeConfig; the session forwards
-    ``cfg.composite.exchange`` / ``cfg.composite.wire``.
+    ``cfg.composite.exchange`` / ``cfg.composite.wire`` (and
+    ``schedule``/``wave_tiles`` — docs/PERF.md "Tile waves": under
+    "waves" each rank `render_slices`-marches one column-block wave at a
+    time while the previous wave's fragments exchange+composite, sharing
+    one permuted copy and occupancy gate per frame).
     """
     from scenery_insitu_tpu.ops import slicer
 
@@ -752,6 +1046,10 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
     if spec.ni % n:
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
+    # validates schedule/wave_tiles values exactly like CompositeConfig
+    waves = _resolve_waves(CompositeConfig(schedule=schedule,
+                                           wave_tiles=wave_tiles),
+                           n, spec.ni, slicer)
 
     # distributed AO: pre-shade each rank's slab with TF + occlusion on a
     # radius-deep halo (seam-exact — see _rank_slab's shade hook), then
@@ -774,14 +1072,39 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                                 spacing, spec, axis, n)
         axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
                                         box_max=gmax)
-        out = slicer.render_slices(vol, tf if not ao_on else None, axcam,
-                                   spec, cfg.early_exit_alpha,
+        tf_r = tf if not ao_on else None
+        bg = (0.0, 0.0, 0.0, 0.0)
+        # rank partials stay background-free; the display warp blends it
+        if waves:
+            # tile-wave schedule: march ONE column-block wave at a time
+            # (u-sliced wave camera), sharing the frame's permuted copy
+            # and occupancy gate, while the previous wave's fragments
+            # exchange + composite (docs/PERF.md "Tile waves")
+            volp = slicer.permute_volume(vol, spec)
+            occ = slicer.occupancy_for(vol, tf_r, spec, volp=volp)
+            _wave_build_marker(n, wave_tiles, 1, spec.nj, spec.ni, 1,
+                               exchange, 0, wire, marched=True)
+
+            def march_wave(w, _):
+                axcam_w, spec_w = slicer.wave_camera(axcam, spec, n,
+                                                     wave_tiles, w)
+                out = slicer.render_slices(vol, tf_r, axcam_w, spec_w,
+                                           cfg.early_exit_alpha,
+                                           v_bounds=v_bounds,
+                                           step_scale=cfg.step_scale,
+                                           occupancy=occ, volp=volp)
+                return (out.image, out.depth), None
+
+            img = _composite_plain_waves(
+                None, None, n, axis, bg, exchange, wire, wave_tiles,
+                march_wave=march_wave)
+            return img, axcam
+        out = slicer.render_slices(vol, tf_r, axcam, spec,
+                                   cfg.early_exit_alpha,
                                    v_bounds=v_bounds,
                                    step_scale=cfg.step_scale)
-        # rank partials stay background-free; the display warp blends it
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
-                                          (0.0, 0.0, 0.0, 0.0),
-                                          exchange, wire), axcam
+                                          bg, exchange, wire), axcam
 
     from scenery_insitu_tpu.ops.slicer import AxisCamera
     out_axcam = AxisCamera(*(P() for _ in AxisCamera._fields))
@@ -797,19 +1120,26 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            cfg: Optional[RenderConfig] = None,
                            axis_name: Optional[str] = None,
                            exchange: str = "all_to_all",
-                           wire: str = "f32"):
+                           wire: str = "f32",
+                           schedule: str = "frame",
+                           wave_tiles: int = 4):
     """Build the jitted distributed plain-image render step (the reference's
     non-VDI mode: VolumeRaycaster + PlainImageCompositor,
     DistributedVolumeRenderer.kt:175-189). Returns ``f(vol_data, origin,
     spacing, cam) -> image f32[4, height, width]`` sharded by W.
     ``exchange`` selects the column-exchange schedule ("all_to_all" |
-    "ring") and ``wire`` the fragment encoding that crosses ICI — see
-    `distributed_plain_step_mxu`."""
+    "ring"), ``wire`` the fragment encoding that crosses ICI, and
+    ``schedule``/``wave_tiles`` the frame granularity (the gather march
+    is monolithic, so "waves" pipelines exchange against composite at
+    column-block granularity) — see `distributed_plain_step_mxu`."""
     cfg = cfg or RenderConfig(width=width, height=height)
     axis = axis_name or mesh.axis_names[0]
     n = mesh.shape[axis]
     if width % n:
         raise ValueError(f"width {width} not divisible by mesh size {n}")
+    waves = _resolve_waves(CompositeConfig(schedule=schedule,
+                                           wave_tiles=wave_tiles),
+                           n, width)
 
     # rank partials must stay background-free — the background is blended
     # exactly once, by the final composite (blending it per rank would
@@ -841,6 +1171,10 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
             ao_vol = Volume(occ[hr - 1:hr + dn + 1], vol.origin, spacing)
         out = raycast(vol, tf, cam, width, height, rank_cfg,
                       clip_min=cmin, clip_max=cmax, ao_field=ao_vol)
+        if waves:
+            return _composite_plain_waves(out.image, out.depth, n, axis,
+                                          cfg.background, exchange, wire,
+                                          wave_tiles)
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
                                           cfg.background, exchange, wire)
 
@@ -889,6 +1223,13 @@ def frame_scan(step, advance, frames: int, temporal: bool = False,
     and ``step`` gains a trailing ``ranges`` argument — frame i renders
     with the ranges its own advance emitted, so no frame in the block
     re-derives occupancy from the volume.
+
+    Tile-wave steps (CompositeConfig.schedule == "waves") scan cleanly:
+    the per-wave state lives INSIDE the step (the wave scan's
+    double-buffered fragment slot; temporal threshold maps update
+    wave-by-wave but cross frames as the same full-frame carry), so the
+    frame scan nests a wave scan per frame — the step's
+    ``wave_schedule_build`` trace event fires when the block traces.
     """
     from scenery_insitu_tpu import obs as _obs
     from scenery_insitu_tpu.core.camera import orbit as _orbit

@@ -267,6 +267,15 @@ class InSituSession:
         self.camera = camera or Camera.create(
             (0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.3, far=20.0)
         self.sinks: List[Sink] = list(sinks)
+        # tile-granular delivery (docs/PERF.md "Tile waves"): with
+        # composite.schedule == "waves" every VDI frame is also split
+        # into its n_ranks * wave_tiles column-block tiles and each tile
+        # payload ({vdi_color, vdi_depth, tile, tiles, col0, frame,
+        # meta}) is handed to these sinks IN COLUMN ORDER before the
+        # frame sinks see the assembled frame — subscribers (e.g.
+        # streaming.stream_tile_sink) start decoding the first columns
+        # while later tiles are still being fetched
+        self.tile_sinks: List[Sink] = []
         self.frame_index = 0
         self.orbit_rate = 0.0  # radians/frame camera sweep (benchmark mode)
         self.steering = None   # optional streaming.SteeringEndpoint
@@ -342,7 +351,9 @@ class InSituSession:
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r,
                 exchange=self.cfg.composite.exchange,
-                wire=self.cfg.composite.wire)
+                wire=self.cfg.composite.wire,
+                schedule=self.cfg.composite.schedule,
+                wave_tiles=self.cfg.composite.wave_tiles)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
@@ -462,22 +473,67 @@ class InSituSession:
 
     def _fetch(self, index: int, out) -> dict:
         from scenery_insitu_tpu.ops.splat import SplatOutput
+        meta = self._pending_meta.pop(index, None)
+        if meta is None:
+            meta = self.frame_metadata(index)
         with self.obs.span("fetch", frame=index):
             if isinstance(out, VDI):
-                payload = {"vdi_color": np.asarray(out.color),
-                           "vdi_depth": np.asarray(out.depth)}
+                # ONE device->host transfer; the tile delivery below and
+                # the frame payload share these buffers
+                color = np.asarray(out.color)
+                depth = np.asarray(out.depth)
+                if self.tile_sinks \
+                        and self.cfg.composite.schedule == "waves":
+                    # tile-granular path: each finished column block is
+                    # delivered BEFORE the frame payload is assembled —
+                    # the frame "closes" (frame sinks run) only after
+                    # every tile is already out the door
+                    self._deliver_tiles(index, None, meta,
+                                        color=color, depth=depth)
+                payload = {"vdi_color": color, "vdi_depth": depth}
             elif isinstance(out, SplatOutput):
                 payload = {"image": np.asarray(out.image),
                            "depth": np.asarray(out.depth)}
             else:
                 payload = {"image": np.asarray(out)}
             payload["frame"] = index
-            payload["meta"] = self._pending_meta.pop(index,
-                                                     self.frame_metadata(index))
+            payload["meta"] = meta
         with self.obs.span("sinks", frame=index):
             for s in self.sinks:
                 s(index, payload)
         return payload
+
+    def _deliver_tiles(self, index: int, out, meta=None,
+                       color=None, depth=None) -> None:
+        """Hand every column-block tile of one composited VDI frame to
+        the tile sinks, in ascending global column order (the delivery
+        contract: tile t covers columns [t*wb, (t+1)*wb) and arrives
+        before tile t+1 and before the frame's own sinks). Tiles are the
+        wave schedule's unit — n_ranks * wave_tiles blocks; a width the
+        tiling does not divide degrades to per-rank blocks."""
+        if meta is None:
+            meta = self._pending_meta.get(index,
+                                          self.frame_metadata(index))
+        if color is None:
+            color = np.asarray(out.color)
+            depth = np.asarray(out.depth)
+        n = self.mesh.shape[self.cfg.mesh.axis_name]
+        tiles = n * self.cfg.composite.wave_tiles
+        w_total = color.shape[-1]
+        if w_total % tiles:
+            tiles = n                       # waves degraded to frame
+        wb = w_total // tiles
+        for t in range(tiles):
+            with self.obs.span("tile", frame=index, tile=t):
+                payload = {
+                    "vdi_color": color[..., t * wb:(t + 1) * wb],
+                    "vdi_depth": depth[..., t * wb:(t + 1) * wb],
+                    "frame": index, "tile": t, "tiles": tiles,
+                    "col0": t * wb, "meta": meta,
+                }
+                self.obs.count("tiles_delivered")
+                for s in self.tile_sinks:
+                    s(index, payload)
 
     def _enter_regime(self, key) -> None:
         if key != getattr(self, "_last_regime_key", key):
@@ -660,6 +716,11 @@ class InSituSession:
                             meta = meta._replace(index=jnp.int32(idx))
                         else:
                             meta = self.frame_metadata(idx, camera=cams[i])
+                        if self.tile_sinks \
+                                and self.cfg.composite.schedule == "waves":
+                            self._deliver_tiles(idx, None, meta,
+                                                color=color[i],
+                                                depth=depth[i])
                         payload = {"vdi_color": color[i],
                                    "vdi_depth": depth[i],
                                    "frame": idx, "meta": meta}
@@ -817,7 +878,9 @@ class InSituSession:
             step = distributed_plain_step_mxu(
                 self.mesh, self.tf, spec, self.cfg.render,
                 exchange=self.cfg.composite.exchange,
-                wire=self.cfg.composite.wire)
+                wire=self.cfg.composite.wire,
+                schedule=self.cfg.composite.schedule,
+                wave_tiles=self.cfg.composite.wave_tiles)
             r = self.cfg.render
             slicer = self._slicer
 
@@ -953,6 +1016,32 @@ def vdi_sink(directory: str, dataset: str = "session", every: int = 1,
         save_vdi(dump_path(directory, dataset, index, "vdi"),
                  _VDI(payload["vdi_color"], payload["vdi_depth"]),
                  codec=codec)
+
+    return sink
+
+
+def vdi_tile_sink(directory: str, dataset: str = "session", every: int = 1,
+                  codec: str = "zstd") -> Sink:
+    """Tile-granular twin of `vdi_sink` for ``InSituSession.tile_sinks``
+    (composite.schedule == "waves"): each finished column-block tile is
+    dumped as its own .npz the moment it is delivered — an offline
+    consumer can start on the first columns before the frame closes. The
+    artifact carries its (tile, tiles, col0) placement
+    (io.vdi_io.save_vdi ``tile=``), so `io.vdi_io.load_vdi_tile` can
+    reassemble frames."""
+    from scenery_insitu_tpu.core.vdi import VDI as _VDI
+    from scenery_insitu_tpu.io.vdi_io import dump_path, save_vdi
+
+    def sink(index: int, payload: dict) -> None:
+        if index % every or "vdi_color" not in payload \
+                or "tile" not in payload:
+            return
+        save_vdi(dump_path(directory, dataset, index,
+                           f"vditile{payload['tile']:02d}"),
+                 _VDI(payload["vdi_color"], payload["vdi_depth"]),
+                 payload.get("meta"), codec=codec,
+                 tile=(payload["tile"], payload["tiles"],
+                       payload["col0"]))
 
     return sink
 

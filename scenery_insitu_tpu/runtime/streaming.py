@@ -84,12 +84,30 @@ class VDIPublisher:
     def publish(self, vdi: VDI, meta: VDIMetadata) -> int:
         """Send one frame; returns wire bytes (≅ the compressed publish loop,
         VolumeFromFileExample.kt:974-1037)."""
+        return self._send(vdi, meta, None)
+
+    def publish_tile(self, vdi: VDI, meta: VDIMetadata, tile: int,
+                     tiles: int, col0: int) -> int:
+        """Send one finished column-block tile of a frame BEFORE the
+        frame closes (the tile-wave delivery unit — docs/PERF.md "Tile
+        waves"; wired to the session by `stream_tile_sink`). The
+        multipart message is the frame format plus a ``tile`` header
+        {tile, tiles, col0}; `VDISubscriber.receive_tile` returns the
+        placement so a viewer can assemble the frame incrementally (or
+        start a partial novel-view render on the columns it has)."""
+        return self._send(vdi, meta,
+                          {"tile": int(tile), "tiles": int(tiles),
+                           "col0": int(col0)})
+
+    def _send(self, vdi: VDI, meta: VDIMetadata,
+              tile: Optional[dict]) -> int:
         from scenery_insitu_tpu import obs as _obs
 
         with _obs.get_recorder().span(
                 "encode", frame=int(np.asarray(meta.index)),
                 sink="vdi_publisher", codec=self.codec,
-                precision=self.precision):
+                precision=self.precision,
+                **({"tile": tile["tile"]} if tile else {})):
             color = np.ascontiguousarray(np.asarray(vdi.color))
             depth = np.ascontiguousarray(np.asarray(vdi.depth))
             qscale = None
@@ -113,6 +131,7 @@ class VDIPublisher:
                 "codec": self.codec,
                 "precision": self.precision,
                 "qscale": qscale,
+                "tile": tile,
                 "color_shape": list(color.shape),
                 "depth_shape": list(depth.shape),
                 "meta": {f: np.asarray(getattr(meta, f)).tolist()
@@ -138,7 +157,18 @@ class VDISubscriber:
 
     def receive(self, timeout_ms: Optional[int] = None
                 ) -> Optional[Tuple[VDI, VDIMetadata]]:
-        zmq = _zmq()
+        got = self.receive_tile(timeout_ms)
+        return None if got is None else got[:2]
+
+    def receive_tile(self, timeout_ms: Optional[int] = None
+                     ) -> Optional[Tuple[VDI, VDIMetadata,
+                                         Optional[dict]]]:
+        """Like `receive`, but also returns the tile placement header
+        ({tile, tiles, col0}) of a `VDIPublisher.publish_tile` message —
+        None for whole-frame messages. Tiles of frame f arrive in
+        column order before frame f closes, so a viewer can assemble
+        incrementally: allocate on the first tile (tiles * width
+        columns), paste each tile at its col0."""
         if timeout_ms is not None:
             if not self.sock.poll(timeout_ms):
                 return None
@@ -170,7 +200,7 @@ class VDISubscriber:
             window_dims=np.asarray(m["window_dims"], np.int32),
             nw=float(np.asarray(m["nw"])), index=int(np.asarray(m["index"])),
             precision=int(np.asarray(m.get("precision", 0))))
-        return VDI(color, depth), meta
+        return VDI(color, depth), meta, h.get("tile")
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -315,6 +345,26 @@ class SteeringRelay:
     def close(self) -> None:
         self.sub.close(linger=0)
         self.pub.close(linger=0)
+
+
+def stream_tile_sink(publisher: VDIPublisher) -> Callable[[int, dict], None]:
+    """Session TILE sink (``InSituSession.tile_sinks``) publishing every
+    delivered column-block tile the moment the session fetches it —
+    paired with ``composite.schedule = "waves"``, subscribers see the
+    frame's first columns while later tiles are still in flight
+    (docs/PERF.md "Tile waves"). Tile payloads arrive as host numpy
+    arrays and are published as-is — no device round trip on the
+    latency-motivated path."""
+
+    def sink(index: int, payload: dict) -> None:
+        if "vdi_color" not in payload or "tile" not in payload:
+            return
+        publisher.publish_tile(
+            VDI(payload["vdi_color"], payload["vdi_depth"]),
+            payload["meta"], payload["tile"], payload["tiles"],
+            payload["col0"])
+
+    return sink
 
 
 def stream_sink(publisher: VDIPublisher) -> Callable[[int, dict], None]:

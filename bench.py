@@ -120,11 +120,17 @@ def _frame_cost(jitted, *args):
     this exact (shapes, donations) step."""
     from scenery_insitu_tpu.obs.device import cost_snapshot
 
+    from scenery_insitu_tpu import obs
+
     snap = cost_snapshot(jitted, *args)
     if snap is None or "bytes_accessed" not in snap:
         err = (snap or {}).get("error", "no cost analysis")
         print(f"[bench] cost analysis unavailable ({err})",
               file=sys.stderr, flush=True)
+        obs.degrade("bench.cost_analysis", "xla_cost_analysis",
+                    "traffic_model", f"backend reported no cost "
+                    f"analysis ({err}) — artifact bytes are the floor "
+                    f"model", warn=False)
         return None, None, snap
     return snap["bytes_accessed"], snap["source"], snap
 
@@ -340,6 +346,13 @@ def main():
                     (time.perf_counter() - t0) / 2 * 1e3, 1)
             except Exception as e:
                 autotune_ms[fname] = f"error: {type(e).__name__}"
+                # a candidate that died is silently dropped from the
+                # autotune race — ledger it so the artifact says WHY the
+                # surviving fold won
+                obs.degrade("bench.autotune_fold", fname, "skipped",
+                            f"autotune candidate failed "
+                            f"({type(e).__name__}: {str(e)[:120]})",
+                            warn=False)
             finally:
                 fr = fs = c2 = d2 = u2 = v2 = t2 = None
         timed = {f: m for f, m in autotune_ms.items()

@@ -154,7 +154,6 @@ def main():
     from scenery_insitu_tpu.io.vdi_io import (dump_path, load_vdi,
                                               pack_vdi_segments, save_vdi,
                                               unpack_vdi_segments)
-    from scenery_insitu_tpu.runtime.timers import Timers
 
     if args.dir:
         paths = sorted(glob.glob(os.path.join(args.dir, "*_subvdi_*.npz")))
@@ -177,7 +176,6 @@ def main():
     k, _, h, w = vdis[0].color.shape
     comp_cfg = CompositeConfig(max_output_supersegments=args.k_out,
                                adaptive_iters=2)
-    timers = Timers(window=args.iters, log=lambda s: None)
 
     if not args.compressed:
         # --------------------------- ICI path: the production SPMD chain

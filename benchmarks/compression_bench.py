@@ -80,6 +80,11 @@ def main():
               ("zlib", 1), ("zlib", 6), ("lzma", 0), ("none", 0)]
     from scenery_insitu_tpu.io import lz4 as _lz4
     if not _lz4.available():
+        from scenery_insitu_tpu import obs
+
+        obs.degrade("bench.codec", "lz4", "skipped",
+                    "native lz4 block codec unavailable (build failed "
+                    "or no toolchain)", warn=False)
         print("  (lz4: native build unavailable, skipped)")
         codecs = [(c, l) for c, l in codecs if c != "lz4"]
     for name, level in codecs:

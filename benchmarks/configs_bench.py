@@ -134,6 +134,12 @@ def main():
         if tpu_devices >= ranks:
             env = dict(os.environ)          # real chips
         else:
+            from scenery_insitu_tpu import obs
+
+            obs.degrade("bench.platform", f"tpu x{ranks}",
+                        "cpu_virtual_mesh",
+                        f"config {n}: probe found {tpu_devices} TPU "
+                        f"device(s), need {ranks}", warn=False)
             env = virtual_mesh_env(max(ranks, 1))
             env["_SITPU_PIN_CPU"] = "1"
         env[_CHILD] = (f"{n},{args.scale},{args.frames},"
@@ -155,6 +161,11 @@ def main():
                                   "error": f"rc={p.returncode}",
                                   "tail": out[-300:]}), flush=True)
         except subprocess.TimeoutExpired:
+            from scenery_insitu_tpu import obs
+
+            obs.degrade("bench.config_run", f"config {n}", "error_row",
+                        f"child timed out after {args.timeout}s",
+                        warn=False)
             print(json.dumps({"metric": f"baseline_config_{n}",
                               "error": f"timeout {args.timeout}s"}),
                   flush=True)

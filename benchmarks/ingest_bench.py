@@ -112,7 +112,6 @@ def bench_fifo(nfloats: int, iters: int) -> float:
     path = f"/tmp/sitpu_fifo_{uuid.uuid4().hex[:8]}"
     os.mkfifo(path)
     frame = np.random.default_rng(0).random(nfloats).astype(np.float32)
-    stop = []
 
     def producer():
         with open(path, "wb") as f:

@@ -73,7 +73,6 @@ def main():
     import dataclasses
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from scenery_insitu_tpu import obs

@@ -37,11 +37,16 @@ def main():
                                                   pin_cpu_backend, probe_tpu)
 
     if os.environ.get("JAX_PLATFORMS") == "cpu" or probe_tpu() == 0:
+        if os.environ.get("JAX_PLATFORMS") != "cpu":
+            from scenery_insitu_tpu import obs
+
+            obs.degrade("bench.platform", "tpu", "cpu",
+                        "profile_frame: TPU probe found no devices",
+                        warn=False)
         pin_cpu_backend()
     enable_compile_cache()
 
     import jax
-    import jax.numpy as jnp
 
     from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
     from scenery_insitu_tpu.core.camera import Camera

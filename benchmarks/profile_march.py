@@ -146,7 +146,6 @@ def main():
         return composite_vdis(color[None], depth[None],
                               CompositeConfig(max_output_supersegments=k,
                                               adaptive_iters=ad_iters)).color
-    v2 = Volume.centered(st.field, extent=2.0)
     vdi, _, _ = jax.jit(lambda d: slicer.generate_vdi_mxu(
         Volume.centered(d, extent=2.0), tf, cam, spec,
         VDIConfig(max_supersegments=k, adaptive_iters=ad_iters)))(vol.data)

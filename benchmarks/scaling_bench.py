@@ -62,6 +62,11 @@ def main():
         from scenery_insitu_tpu.utils.backend import probe_tpu
 
         if probe_tpu() == 0:
+            from scenery_insitu_tpu import obs
+
+            obs.degrade("bench.platform", "tpu", "cpu",
+                        "scaling_bench: TPU probe found no devices",
+                        warn=False)
             tpu_probe_failed = True
 
     import jax

@@ -216,4 +216,12 @@ def for_dataset(name: str) -> TransferFunction:
     try:
         return DATASET_TRANSFER_FUNCTIONS[name.lower()]()
     except KeyError:
+        # an unknown dataset renders with the generic gray ramp — a real
+        # behavior change (a typo'd runtime.dataset silently loses the
+        # tuned TF), so it lands on the fallback ledger
+        from scenery_insitu_tpu import obs
+
+        obs.degrade("core.dataset_tf", name, "grays_ramp",
+                    f"no tuned transfer function for dataset {name!r} "
+                    f"(known: {sorted(DATASET_TRANSFER_FUNCTIONS)})")
         return TransferFunction.ramp(0.05, 0.8, 0.5, "grays")

@@ -25,8 +25,15 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libshm_transport.so")
-DEMO_PRODUCER = os.path.join(_NATIVE_DIR, "build", "demo_producer")
+# SITPU_NATIVE_BUILD selects the Makefile build variant: "build" (the
+# default) or "build-asan" (`make asan` — the
+# -fsanitize=address,undefined instrumented .so the CI sanitizer job
+# runs the ingest tests against; needs LD_PRELOAD of the ASan runtime,
+# see native/Makefile)
+_BUILD_DIR = os.environ.get("SITPU_NATIVE_BUILD", "build")
+_MAKE_TARGET = "asan" if _BUILD_DIR == "build-asan" else "all"
+_LIB_PATH = os.path.join(_NATIVE_DIR, _BUILD_DIR, "libshm_transport.so")
+DEMO_PRODUCER = os.path.join(_NATIVE_DIR, _BUILD_DIR, "demo_producer")
 
 _lib = None
 
@@ -47,7 +54,7 @@ def ensure_built(force: bool = False) -> str:
     stale = (not os.path.exists(_LIB_PATH)
              or os.path.getmtime(_LIB_PATH) < _sources_mtime())
     if force or stale:
-        subprocess.run(["make", "-C", _NATIVE_DIR],
+        subprocess.run(["make", "-C", _NATIVE_DIR, _MAKE_TARGET],
                        check=True, capture_output=True)
     return _LIB_PATH
 

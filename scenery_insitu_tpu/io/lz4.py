@@ -15,9 +15,11 @@ from __future__ import annotations
 import ctypes
 import os
 
+# SITPU_NATIVE_BUILD: same build-variant switch as ingest/shm.py (the
+# ASan CI job points both bindings at the instrumented build dir)
 _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "ingest", "native", "build",
-    "liblz4block.so")
+    os.path.abspath(__file__))), "ingest", "native",
+    os.environ.get("SITPU_NATIVE_BUILD", "build"), "liblz4block.so")
 
 _lib = None
 

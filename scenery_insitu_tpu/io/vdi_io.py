@@ -119,7 +119,7 @@ def compress(data: bytes, codec: str = "zstd", level: int = -1) -> bytes:
     try:
         enc, _ = CODECS[codec]
     except KeyError:
-        raise ValueError(f"unknown codec {codec!r}; have {sorted(CODECS)}")
+        raise ValueError(f"unknown codec {codec!r}; have {sorted(CODECS)}") from None
     return enc(data, level)
 
 
@@ -127,7 +127,7 @@ def decompress(data: bytes, codec: str = "zstd") -> bytes:
     try:
         _, dec = CODECS[codec]
     except KeyError:
-        raise ValueError(f"unknown codec {codec!r}; have {sorted(CODECS)}")
+        raise ValueError(f"unknown codec {codec!r}; have {sorted(CODECS)}") from None
     return dec(data)
 
 

@@ -9,7 +9,8 @@ touches JAX only inside its functions.
 
 from scenery_insitu_tpu.obs.recorder import (Recorder, clear_ledger,
                                              degrade, get_recorder,
-                                             ledger, set_recorder)
+                                             ledger, ledger_registry,
+                                             set_recorder)
 
-__all__ = ["Recorder", "degrade", "ledger", "clear_ledger",
-           "get_recorder", "set_recorder"]
+__all__ = ["Recorder", "degrade", "ledger", "ledger_registry",
+           "clear_ledger", "get_recorder", "set_recorder"]

@@ -82,6 +82,78 @@ def degrade(component: str, from_: str, to: str, reason: str,
     return entry
 
 
+# The component catalog of every degrade() SITE in the repo — the static
+# half of the ledger contract. sitpu-lint's SITPU-LEDGER checker
+# discovers the sites by AST scan and tests/test_lint.py holds the two
+# equal in both directions: a new degrade() call must register its
+# component here (so docs/OBSERVABILITY.md stays complete), and a
+# registry row without a live site is dead weight that must go. Keys are
+# components, values say what degrading means there.
+_LEDGER_REGISTRY: Dict[str, str] = {
+    "bench.adaptive_mode": "bench: temporal adaptive mode needs the mxu "
+                           "engine; histogram runs instead",
+    "bench.autotune_fold": "bench: a fold-autotune candidate crashed and "
+                           "is dropped from the race",
+    "bench.codec": "benchmarks: a codec under test is unavailable and "
+                   "skipped (e.g. no native lz4 build)",
+    "bench.config_run": "configs_bench: a per-config child run failed or "
+                        "timed out; the artifact records an error row",
+    "bench.cost_analysis": "bench: XLA cost analysis unavailable; "
+                           "artifact bytes fall back to the floor model",
+    "bench.platform": "bench/benchmarks: the TPU attempt gave way to the "
+                      "CPU (or virtual-mesh) fallback",
+    "bench.platform_attempt": "bench: one platform attempt failed "
+                              "(per-attempt reason in failed_attempts)",
+    "bench.scan_frames": "bench: SCAN_FRAMES requested without temporal "
+                         "mxu mode; eager per-frame dispatch runs",
+    "composite.schedule": "tile waves requested on a single-rank mesh; "
+                          "frame schedule runs (nothing to overlap)",
+    "config.removed_key": "a removed config key was set and ignored "
+                          "(deprecation note in the reason)",
+    "core.dataset_tf": "unknown dataset name; the generic gray-ramp "
+                       "transfer function renders instead of a tuned one",
+    "io.vdi_codec": "zstd codec unavailable; VDI IO degrades to stdlib "
+                    "zlib",
+    "occupancy.k_budget": "occupancy K budgets requested where no "
+                          "pyramid/adaptive threshold exists; static "
+                          "budgets run",
+    "occupancy.ranges_remap": "sim-fused brick ranges coarsened onto an "
+                              "incommensurate canonical grid (gcd bands)",
+    "occupancy.sim_ranges": "fused-stencil ranges epilogue unavailable; "
+                            "lax field_ranges recompute runs",
+    "occupancy.vtiles_clamp": "requested in-plane occupancy tiles exceed "
+                              "the geometry; clamped",
+    "ops.composite_fold": "Mosaic rejected the fused composite resegment "
+                          "kernel; XLA scan composite runs",
+    "ops.count_fold": "Mosaic rejected the counting kernel; XLA counting "
+                      "scan runs",
+    "ops.march_fold": "Mosaic rejected the march fold kernel; XLA fold "
+                      "runs",
+    "ops.pallas_march.block_width": "kernel block width clamped below "
+                                    "the VMEM-budget request",
+    "ops.seg_fold": "Mosaic rejected a seg/fused fold kernel; the probed "
+                    "seg stack runs",
+    "phase_bench.sim_fused": "phase_bench: --sim-fused needs a 1-rank "
+                             "mesh; xla_roll runs",
+    "session.scan_block": "a scan block fell back to eager frames "
+                          "(regime change or steering drain)",
+    "session.scan_frames": "scan_frames configured but unsupported in "
+                           "this mode; eager loop runs",
+    "sim.fused_stencil": "fused Pallas stencil unavailable; XLA roll "
+                         "formulation advances the sim",
+    "sim.stencil_schedule": "Mosaic rejected every probed stencil "
+                            "schedule candidate for this grid/T",
+}
+
+
+def ledger_registry() -> Dict[str, str]:
+    """The static component catalog of the fallback ledger — every
+    component a ``degrade()`` site in this repo can mint, with a one-line
+    meaning. Cross-validated against the AST-discovered site list by
+    sitpu-lint's round-trip test; see docs/STATIC_ANALYSIS.md."""
+    return dict(_LEDGER_REGISTRY)
+
+
 def ledger() -> List[Dict[str, Any]]:
     """Snapshot of every degradation reported so far (insertion order)."""
     with _LEDGER_LOCK:

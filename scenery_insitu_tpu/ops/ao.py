@@ -23,8 +23,6 @@ the reference's own inactive scaffolding.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 
 from scenery_insitu_tpu.core.transfer import TransferFunction

@@ -113,7 +113,21 @@ def resegment_stream(sc: jnp.ndarray, sd: jnp.ndarray,
 
     backend = cfg.backend
     if backend == "auto":
-        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+        # auto is probe-gated like every other auto-picked Pallas
+        # schedule (ADVICE r5 #4): a shape-dependent Mosaic rejection of
+        # the fused resegment kernel must degrade to the XLA scan HERE
+        # (the probe ledgers it as ops.composite_fold), not fire inside
+        # a traced frame step. An explicit backend="pallas" stays
+        # trusted-unprobed.
+        if jax.default_backend() == "tpu":
+            from scenery_insitu_tpu.ops.pallas_composite import \
+                composite_compile_ok
+            nk = sc.shape[0]
+            backend = "pallas" if composite_compile_ok(
+                nk, k_out, cfg.adaptive_iters if cfg.adaptive else 0) \
+                else "xla"
+        else:
+            backend = "xla"
 
     if backend == "pallas":
         # fully fused: the adaptive threshold search runs inside the kernel

@@ -55,7 +55,6 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from scenery_insitu_tpu import obs

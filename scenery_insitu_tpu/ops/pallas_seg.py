@@ -589,3 +589,67 @@ def seg_compile_ok(max_k: int = 32, chunk: int = 16,
             ok = False
         _PROBE[key] = ok
     return ok
+
+
+_FUSED_PROBE: dict = {}
+
+
+def fused_compile_ok(max_k: int = 32, chunk: int = 16,
+                     width: int = 2048, stream: bool = False) -> bool:
+    """One-time Mosaic-acceptance probe for the shade-in-kernel folds:
+    `fused_fold_chunk` (``stream=False``, fold="pallas_fused") and
+    `fused_stream_fold` (``stream=True``, fold="fused_stream") at the
+    real (K, chunk, width) geometry. The TF constants are baked into the
+    kernel but only change scalars, not structure or VMEM, so a generic
+    ramp TF probes the same kernel Mosaic judges in production.
+    `slicer.make_spec` consults this when a fused fold is explicitly
+    requested ON TPU and degrades to the probed pallas_seg/seg stack on
+    rejection (ledgered as ops.seg_fold) — same rationale as the auto
+    probes: a resource rejection must land here, not inside a traced
+    frame step. Off-TPU the fused folds run in interpret mode and are
+    never probed."""
+    from scenery_insitu_tpu.ops.pallas_util import mosaic_probe
+
+    def compile_fn():
+        from scenery_insitu_tpu.core.transfer import TransferFunction
+
+        tf = TransferFunction.ramp(0.0, 1.0, 0.5, "grays")
+        k, c, h, w = int(max_k), int(chunk), TILE_H, int(width)
+        sds = jax.ShapeDtypeStruct
+        pk = (sds((k, 4, h, w), jnp.float32),
+              sds((k, 2, h, w), jnp.float32),
+              sds((_NSMALL, h, w), jnp.float32))
+        if stream:
+            s_total = 2 * c           # exercises the multi-chunk grid
+
+            def f(pk, val, ln, ratio, sk0, sk1, thr):
+                return fused_stream_fold(pk, val, ln, ratio, sk0,
+                                         sk1, thr, max_k=k, chunk=c,
+                                         tf=tf, interpret=False)
+
+            jax.jit(f).lower(
+                pk, sds((s_total, h, w), jnp.float32),
+                sds((h, w), jnp.float32), sds((h, w), jnp.float32),
+                sds((s_total,), jnp.float32),
+                sds((s_total,), jnp.float32),
+                sds((h, w), jnp.float32)).compile()
+        else:
+            def f(pk, val, ln, ratio, sk0, sk1, thr):
+                return fused_fold_chunk(pk, val, ln, ratio, sk0,
+                                        sk1, thr, max_k=k, tf=tf,
+                                        interpret=False)
+
+            jax.jit(f).lower(
+                pk, sds((c, h, w), jnp.float32),
+                sds((h, w), jnp.float32), sds((h, w), jnp.float32),
+                sds((c,), jnp.float32), sds((c,), jnp.float32),
+                sds((h, w), jnp.float32)).compile()
+
+    return mosaic_probe(
+        _FUSED_PROBE,
+        (jax.default_backend(), int(max_k), int(chunk), int(width),
+         bool(stream)),
+        compile_fn, "ops.seg_fold",
+        "fused_stream" if stream else "pallas_fused", "seg",
+        f"Mosaic rejected the fused fold at k={max_k} chunk={chunk} "
+        f"width={width} stream={stream}")

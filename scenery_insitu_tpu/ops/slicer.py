@@ -193,6 +193,18 @@ def make_spec(cam: Camera, vol_shape: Tuple[int, int, int],
         raise ValueError(f"unknown fold schedule {fold!r} (expected 'auto', "
                          "'xla', 'pallas', 'seg', 'pallas_seg', "
                          "'pallas_fused' or 'fused_stream')")
+    if fold in ("pallas_fused", "fused_stream") \
+            and jax.default_backend() == "tpu" \
+            and not psg.fused_compile_ok(32, cfg.chunk, ni,
+                                         stream=(fold == "fused_stream")):
+        # an explicitly requested fused fold that Mosaic rejects AT THIS
+        # GEOMETRY must degrade here (the probe ledgered it as
+        # ops.seg_fold), not compile-crash inside a traced frame step;
+        # fall back to the same probed stack the auto resolution uses.
+        # Off-TPU the fused folds run in interpret mode — never probed,
+        # never degraded.
+        fold = ("pallas_seg" if psg.seg_compile_ok(32, cfg.chunk, ni)
+                and pm.count_compile_ok(32, cfg.chunk, ni) else "seg")
     # resolve the benched auto default (-1): in-plane tiling pays on the
     # TPU march (the A/B in benchmarks/occupancy_bench.py — sparse
     # fields skip most cells) but adds nt lax.cond branches per chunk,

@@ -142,7 +142,6 @@ def pack_3layer(vdi: VDI) -> np.ndarray:
 
 def unpack_3layer(packed: np.ndarray) -> VDI:
     """Inverse of `pack_3layer` (zero-alpha zero-extent slots -> empty)."""
-    k = packed.shape[0] // 3
     color = jnp.asarray(np.moveaxis(packed[0::3], -1, 1), jnp.float32)
     start = np.asarray(packed[1::3, :, :, 0], np.float32)
     end = np.asarray(packed[2::3, :, :, 0], np.float32)

@@ -73,7 +73,7 @@ def wire_slot_bytes(wire: str) -> Tuple[int, int]:
     try:
         return WIRE_SLOT_BYTES[wire]
     except KeyError:
-        raise ValueError(f"unknown wire format {wire!r}; have {WIRE_FORMATS}")
+        raise ValueError(f"unknown wire format {wire!r}; have {WIRE_FORMATS}") from None
 
 
 def _count_encode(wire: str, cb: int, db: int) -> None:

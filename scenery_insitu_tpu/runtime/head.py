@@ -92,7 +92,7 @@ class HeadNode:
     def pump(self, timeout_ms: int = 100) -> int:
         """Receive pending rank messages; composite every completed frame
         set; returns number of frames composited this call."""
-        zmq = _zmq()
+        _zmq()                  # fail fast if pyzmq is missing
         done = 0
         while self.sock.poll(timeout_ms):
             header, iblob, dblob = self.sock.recv_multipart()

@@ -1,0 +1,488 @@
+"""SITPU-TRACE — host-sync and retrace hazards inside jitted/scanned code.
+
+The pipelined overlap structure (ring exchange, tile waves, frame scan)
+only holds while the compiled step stays on device: one stray ``float(x)``
+on a traced value forces a device->host transfer mid-step (serializing the
+very collectives PRs 4/8 overlap), a Python ``if`` on a traced boolean is
+a trace-time error (or, via weak typing, a silent per-call retrace), and a
+``jnp.array`` literal built inside a ``lax.scan`` body re-materializes a
+constant every iteration. These never fail loudly on the CPU parity tests
+— interpret mode and tiny grids hide them — so they are exactly the class
+of bug a static pass must hold the line on.
+
+Mechanics (per module, no execution):
+
+1. **traced contexts** — functions decorated with / passed to ``jit``,
+   ``shard_map``, ``vmap``/``pmap``/``grad``, or used as ``lax.scan`` /
+   ``cond`` / ``while_loop`` / ``fori_loop`` bodies; plus their nested
+   defs and (fixpoint) same-module functions they call. ``lax.scan``
+   bodies are additionally tagged for the per-step-literal rule.
+2. **a tiny dataflow** inside each traced function: parameters are
+   traced unless they are statically-shaped configuration — name
+   matches the project's config idiom (``*_cfg``, ``spec``, ``mesh``,
+   ``axis``...), scalar/str annotation, or a literal default. ``x.shape``
+   / ``.dtype`` / ``.ndim`` /`` .size`` of a traced value is static
+   (shapes are trace-time constants); ``is``/``is not None`` tests are
+   static (pytree structure). Everything derived from a traced value —
+   arithmetic, indexing, ``jnp.*`` results — is traced.
+3. **hazards** flagged on traced values: ``float()``/``int()``/
+   ``bool()`` concretization, ``np.asarray``/``np.array`` host pulls,
+   ``.item()``/``.tolist()``, Python ``if``/``while``/ternary/``assert``
+   control flow; in scan bodies, ``jnp.array``/``jnp.asarray`` calls on
+   constants-only arguments; and ``jit(..., static_argnames=...)`` naming
+   parameters the jitted function does not have.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from scenery_insitu_tpu.tools.lint.core import (Diagnostic, SourceFile,
+                                                dotted_name, func_params)
+
+CODE = "SITPU-TRACE"
+
+# jax transforms that trace their function argument
+_TRACERS = {"jit", "shard_map", "vmap", "pmap", "grad", "value_and_grad",
+            "checkpoint", "remat", "custom_vjp", "custom_jvp"}
+_BODY_TAKERS = {"scan": 0, "cond": None, "while_loop": None,
+                "fori_loop": 2, "map": 0, "associative_scan": 0}
+
+# parameters that are static configuration by project convention
+_STATIC_NAME_RE = re.compile(
+    r"(^|_)(cfg|config|spec|specs|mesh|tf|axis|axis_name|slicer|engine|"
+    r"mode|kind|wire|exchange|schedule|fold|background|colormap|"
+    r"interpret|temporal|dtype|name|log|rec|recorder|key|sim)$"
+    r"|^(self|n|t|k|w|h|d)$")
+_STATIC_ANNOT = {"int", "float", "bool", "str", "bytes", "tuple", "list",
+                 "dict"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
+                 "_fields"}
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+_NUMPY_BASES = {"np", "numpy", "onp", "_np"}
+
+
+def _is_static_param(arg: ast.arg) -> bool:
+    if _STATIC_NAME_RE.search(arg.arg):
+        return True
+    ann = arg.annotation
+    if ann is not None:
+        names = {n.id for n in ast.walk(ann) if isinstance(n, ast.Name)}
+        names |= {n.attr for n in ast.walk(ann)
+                  if isinstance(n, ast.Attribute)}
+        # Optional[int], Tuple[int, int], str, ... — but jnp.ndarray /
+        # Camera / VDI pytrees stay traced
+        if names and names <= (_STATIC_ANNOT | {"Optional", "Tuple",
+                                                "List", "Dict"}):
+            return True
+    return False
+
+
+def _static_params(fn) -> Set[str]:
+    a = fn.args
+    out = set()
+    all_args = a.posonlyargs + a.args + a.kwonlyargs
+    # literal defaults (trailing-aligned for positional args)
+    defaults = {}
+    pos = a.posonlyargs + a.args
+    for p, dflt in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        defaults[p.arg] = dflt
+    for p, dflt in zip(a.kwonlyargs, a.kw_defaults):
+        if dflt is not None:
+            defaults[p.arg] = dflt
+    for p in all_args:
+        d = defaults.get(p.arg)
+        literal_default = isinstance(d, ast.Constant) and not (
+            d.value is None)
+        if _is_static_param(p) or literal_default:
+            out.add(p.arg)
+    return out
+
+
+# ------------------------------------------------------- context discovery
+
+class _FnIndex:
+    """All function defs in a module, with name -> defs map (lexically
+    scoped resolution is overkill; bare-name match is right for this
+    codebase's flat modules)."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: List = []
+        self.by_name: Dict[str, List] = {}
+        self.parent: Dict[ast.AST, Optional[ast.AST]] = {}
+        self._walk(tree, None)
+
+    def _walk(self, node, parent_fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.append(child)
+                self.by_name.setdefault(child.name, []).append(child)
+                self.parent[child] = parent_fn
+                self._walk(child, child)
+            else:
+                self._walk(child, parent_fn)
+
+
+def _resolve_fn_arg(expr, idx: _FnIndex):
+    """The function a call argument names: bare Name, or
+    functools.partial(fn, ...)'s first arg."""
+    if isinstance(expr, ast.Name):
+        defs = idx.by_name.get(expr.id)
+        return defs[-1] if defs else None
+    if isinstance(expr, ast.Call):
+        dn = dotted_name(expr.func)
+        if dn.endswith("partial") and expr.args:
+            return _resolve_fn_arg(expr.args[0], idx)
+    return None
+
+
+def _decorated_traced(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        for n in ast.walk(target if not isinstance(dec, ast.Call) else dec):
+            if isinstance(n, ast.Attribute) and n.attr in _TRACERS:
+                return True
+            if isinstance(n, ast.Name) and n.id in _TRACERS:
+                return True
+    return False
+
+
+def find_traced(tree: ast.Module, idx: _FnIndex
+                ) -> Tuple[Set[ast.AST], Set[ast.AST], Dict[ast.AST,
+                                                            Set[str]]]:
+    """(traced defs, scan-body defs, per-def jit static_argnames) for one
+    module — the traced ROOTS only; argument-aware closure over
+    same-module calls happens in :func:`check` (a helper called from a
+    traced function is only traced if some call site actually passes it
+    a traced value — ``step_pallas`` consulting its host-side candidate
+    walkers on static shapes must not drag them in)."""
+    traced: Set[ast.AST] = set()
+    scan_bodies: Set[ast.AST] = set()
+    static_names: Dict[ast.AST, Set[str]] = {}
+    for fn in idx.defs:
+        if _decorated_traced(fn):
+            traced.add(fn)
+        for dec in fn.decorator_list:
+            names = _jit_static_argnames(dec)
+            if names:
+                static_names.setdefault(fn, set()).update(names)
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        dn = dotted_name(call.func)
+        leaf = dn.rsplit(".", 1)[-1] if dn else ""
+        if leaf in _TRACERS and call.args:
+            t = _resolve_fn_arg(call.args[0], idx)
+            if t is not None:
+                traced.add(t)
+                names = _jit_static_argnames(call)
+                if names:
+                    static_names.setdefault(t, set()).update(names)
+        if leaf in _BODY_TAKERS:
+            argpos = _BODY_TAKERS[leaf]
+            cands = (call.args if argpos is None else
+                     call.args[argpos:argpos + 1]
+                     if len(call.args) > (argpos or 0) else [])
+            for a in cands:
+                t = _resolve_fn_arg(a, idx)
+                if t is not None:
+                    traced.add(t)
+                    if leaf == "scan":
+                        scan_bodies.add(t)
+    # nested defs inherit their parent's tracedness
+    changed = True
+    while changed:
+        changed = False
+        for fn in idx.defs:
+            if fn not in traced and idx.parent.get(fn) in traced:
+                traced.add(fn)
+                changed = True
+    return traced, scan_bodies, static_names
+
+
+def _jit_static_argnames(call_or_dec) -> List[str]:
+    """static_argnames of a ``jit(...)`` / ``partial(jit, ...)`` call."""
+    if not isinstance(call_or_dec, ast.Call):
+        return []
+    dn = dotted_name(call_or_dec.func)
+    leaf = dn.rsplit(".", 1)[-1] if dn else ""
+    if leaf == "partial":
+        if not (call_or_dec.args
+                and dotted_name(call_or_dec.args[0]).endswith("jit")):
+            return []
+    elif leaf != "jit":
+        return []
+    for k in call_or_dec.keywords:
+        if k.arg == "static_argnames":
+            return _literal_strs(k.value) or []
+    return []
+
+
+# ------------------------------------------------------------ the dataflow
+
+class _Flow(ast.NodeVisitor):
+    def __init__(self, src: SourceFile, fn, scan_body: bool,
+                 diags: List[Diagnostic],
+                 extra_static: Optional[Set[str]] = None,
+                 emit: bool = True):
+        self.src = src
+        self.fn = fn
+        self.scan_body = scan_body
+        self.diags = diags
+        self.emit = emit
+        self.traced_calls: Set[str] = set()   # same-module callees fed a
+        #                                       traced argument
+        static = _static_params(fn) | (extra_static or set())
+        self.traced: Set[str] = {p for p in func_params(fn)
+                                 if p not in static}
+
+    def flag(self, node, msg):
+        if self.emit:
+            self.diags.append(Diagnostic(self.src.path, node.lineno, CODE,
+                                         msg, self.fn.name))
+
+    # ------------------------------------------------------- tracedness
+    def is_traced(self, e) -> bool:
+        if e is None or isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.traced
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return False
+            return self.is_traced(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.is_traced(e.value)
+        if isinstance(e, ast.Call):
+            # a method call on a traced array (x.max(), x.astype(...))
+            # yields a traced value; .item()/.tolist() yield host values
+            # (and are flagged as hazards in visit_Call)
+            if isinstance(e.func, ast.Attribute) \
+                    and e.func.attr not in ("item", "tolist") \
+                    and self.is_traced(e.func.value):
+                return True
+            dn = dotted_name(e.func)
+            root = dn.split(".", 1)[0] if dn else ""
+            leaf = dn.rsplit(".", 1)[-1] if dn else ""
+            if leaf in _CONCRETIZERS or leaf in ("len", "range", "repr",
+                                                 "str"):
+                return False
+            if root in ("jnp", "lax") or dn.startswith(
+                    ("jax.lax.", "jax.numpy.", "jax.nn.", "jax.random.",
+                     "jax.scipy.")):
+                # rank/shape queries are trace-time constants even on
+                # traced arrays; everything else these namespaces return
+                # is a device value
+                return leaf not in ("ndim", "shape", "size",
+                                    "result_type", "isdtype")
+            # other jax.* (default_backend, ShapeDtypeStruct, tree_util,
+            # jit...) are host utilities — fall through to argument-based
+            # propagation
+            args = list(e.args) + [k.value for k in e.keywords]
+            return any(self.is_traced(a) for a in args)
+        if isinstance(e, ast.BinOp):
+            return self.is_traced(e.left) or self.is_traced(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.is_traced(e.operand)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False               # pytree-structure check
+            return (self.is_traced(e.left)
+                    or any(self.is_traced(c) for c in e.comparators))
+        if isinstance(e, ast.BoolOp):
+            return any(self.is_traced(v) for v in e.values)
+        if isinstance(e, ast.IfExp):
+            return self.is_traced(e.body) or self.is_traced(e.orelse)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self.is_traced(v) for v in e.elts)
+        if isinstance(e, ast.Starred):
+            return self.is_traced(e.value)
+        return False
+
+    def _bind(self, target, traced: bool):
+        if isinstance(target, ast.Name):
+            if traced:
+                self.traced.add(target.id)
+            else:
+                self.traced.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self._bind(t, traced)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, traced)
+
+    # ------------------------------------------------------- statements
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        t = self.is_traced(node.value)
+        for target in node.targets:
+            self._bind(target, t)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        if self.is_traced(node.value):
+            self._bind(node.target, True)
+
+    def visit_AnnAssign(self, node):
+        self.generic_visit(node)
+        if node.value is not None:
+            self._bind(node.target, self.is_traced(node.value))
+
+    def visit_For(self, node):
+        self._bind(node.target, self.is_traced(node.iter))
+        self.generic_visit(node)
+
+    def visit_If(self, node):
+        if self.is_traced(node.test):
+            self.flag(node.test, "Python `if` on a traced value — "
+                      "trace-time error or silent per-call retrace; use "
+                      "lax.cond / jnp.where")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.is_traced(node.test):
+            self.flag(node.test, "Python `while` on a traced value — use "
+                      "lax.while_loop")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self.is_traced(node.test):
+            self.flag(node.test, "assert on a traced value — trace-time "
+                      "error; use checkify or a host callback")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        if self.is_traced(node.test):
+            self.flag(node.test, "ternary on a traced condition — use "
+                      "jnp.where / lax.select")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        if node is not self.fn:
+            return                 # nested defs get their own _Flow pass
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        dn = dotted_name(node.func)
+        leaf = dn.rsplit(".", 1)[-1] if dn else ""
+        root = dn.split(".", 1)[0] if dn else ""
+        args = list(node.args) + [k.value for k in node.keywords]
+        any_traced = any(self.is_traced(a) for a in args)
+        if any_traced and isinstance(node.func, ast.Name):
+            self.traced_calls.add(node.func.id)
+        if isinstance(node.func, ast.Name) and leaf in _CONCRETIZERS \
+                and any_traced:
+            self.flag(node, f"{leaf}() on a traced value forces a "
+                      f"device->host sync inside compiled code")
+        if root in _NUMPY_BASES and leaf in ("asarray", "array") \
+                and any_traced:
+            self.flag(node, f"{dn}() pulls a traced value to host "
+                      f"memory inside compiled code — use jnp")
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist") \
+                and self.is_traced(node.func.value):
+            self.flag(node, f".{node.func.attr}() on a traced value is a "
+                      f"host sync inside compiled code")
+        if self.scan_body and root == "jnp" \
+                and leaf in ("array", "asarray") and args \
+                and not any_traced \
+                and all(_is_constish(a) for a in args):
+            self.flag(node, "jnp." + leaf + " literal constructed inside "
+                      "a lax.scan body — hoist it out of the scanned "
+                      "step (per-iteration constant re-materialization)")
+
+
+def _is_constish(e) -> bool:
+    return all(isinstance(n, (ast.Constant, ast.Tuple, ast.List,
+                              ast.UnaryOp, ast.USub, ast.UAdd,
+                              ast.operator, ast.unaryop, ast.Load))
+               for n in ast.walk(e))
+
+
+# -------------------------------------------------- static_argnames checks
+
+def _check_static_argnames(src: SourceFile, idx: _FnIndex
+                           ) -> List[Diagnostic]:
+    diags = []
+    for call in ast.walk(src.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        dn = dotted_name(call.func)
+        if not dn or dn.rsplit(".", 1)[-1] not in ("jit", "partial"):
+            continue
+        is_partial = dn.rsplit(".", 1)[-1] == "partial"
+        if is_partial:
+            # functools.partial(jax.jit, static_argnames=...) decorator
+            if not (call.args and dotted_name(call.args[0]).endswith("jit")):
+                continue
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        if "static_argnames" not in kw:
+            continue
+        names = _literal_strs(kw["static_argnames"])
+        if names is None:
+            continue
+        target = None
+        if not is_partial and call.args:
+            target = _resolve_fn_arg(call.args[0], idx)
+        if is_partial:
+            for fn in idx.defs:
+                for dec in fn.decorator_list:
+                    if dec is call:
+                        target = fn
+        if target is None:
+            continue
+        missing = [n for n in names if n not in func_params(target)]
+        if missing:
+            diags.append(Diagnostic(
+                src.path, call.lineno, CODE,
+                f"static_argnames {missing} are not parameters of "
+                f"{target.name}() — jit will raise (or silently trace "
+                f"them) at call time", target.name))
+    return diags
+
+
+def _literal_strs(e) -> Optional[List[str]]:
+    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+        return [e.value]
+    if isinstance(e, (ast.Tuple, ast.List)):
+        out = []
+        for v in e.elts:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.append(v.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def check(sources: List[SourceFile]) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for src in sources:
+        idx = _FnIndex(src.tree)
+        traced, scan_bodies, static_names = find_traced(src.tree, idx)
+        # argument-aware closure: a same-module top-level helper joins the
+        # traced set only when some traced function passes it a traced
+        # value (host-side helpers consulted on static shapes stay host)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                flow = _Flow(src, fn, fn in scan_bodies, diags,
+                             static_names.get(fn), emit=False)
+                flow.visit(fn)
+                for name in flow.traced_calls:
+                    for t in idx.by_name.get(name, []):
+                        if idx.parent.get(t) is None and t not in traced:
+                            traced.add(t)
+                            changed = True
+        for fn in idx.defs:
+            if fn in traced:
+                _Flow(src, fn, fn in scan_bodies, diags,
+                      static_names.get(fn)).visit(fn)
+        diags.extend(_check_static_argnames(src, idx))
+    return diags

@@ -1,0 +1,54 @@
+# SITPU-LEDGER bad fixture: behavior-changing fallbacks with no ledger
+# entry. Parsed by the linter only — never imported or executed.
+
+
+def load_codec():
+    try:
+        import fastcodec
+        return fastcodec
+    except ImportError:
+        # swaps the codec implementation silently — must degrade()
+        import slowcodec
+        return slowcodec
+
+
+def pick_backend(data):
+    try:
+        result = fast_path(data)
+    except Exception as e:
+        print(f"fast path failed ({e}); using slow path")
+        result = slow_path(data)
+    return result
+
+
+def have_turbo():
+    # probe predicate: returning a constant from the handler is FINE
+    # here — the caller owns the fallback decision
+    try:
+        import turbo  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def run(data):
+    # consults the probe, silently picks an implementation, no ledger
+    if have_turbo():
+        return turbo_run(data)
+    return plain_run(data)
+
+
+def fast_path(data):
+    return data
+
+
+def slow_path(data):
+    return data
+
+
+def turbo_run(data):
+    return data
+
+
+def plain_run(data):
+    return data

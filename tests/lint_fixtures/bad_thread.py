@@ -1,0 +1,45 @@
+# SITPU-THREAD bad fixture: distributed step builders that drop knobs.
+# Parsed by the linter only.
+
+
+def distributed_bad_step(mesh, tf, width, height,
+                         exchange="all_to_all", wire="f32",
+                         schedule="frame", wave_tiles=4,
+                         ring_slots=0, k_budget="static"):
+    """Accepts the full knob matrix but the ``wire`` forwarding has been
+    DELETED (the acceptance-criteria demo: this is exactly what removing
+    ``wire=...`` from a real builder's composite call looks like)."""
+    def step(data, cam):
+        frag = march(data, cam)
+        return composite(frag, exchange=exchange,
+                         schedule=schedule, wave_tiles=wave_tiles,
+                         ring_slots=ring_slots, k_budget=k_budget)
+    return step
+
+
+def distributed_missing_step(mesh, tf, width, height,
+                             exchange="all_to_all"):
+    """Accepts only one knob of the matrix — every other knob is
+    invisible to callers and silently pinned to the composite default."""
+    def step(data, cam):
+        return composite(march(data, cam), exchange=exchange)
+    return step
+
+
+def distributed_dropped_obj_step(mesh, tf, comp_cfg=None):
+    """Takes the whole config object and then never threads it."""
+    def step(data, cam):
+        return composite_default(march(data, cam))
+    return step
+
+
+def march(data, cam):
+    return data
+
+
+def composite(frag, **kw):
+    return frag
+
+
+def composite_default(frag):
+    return frag

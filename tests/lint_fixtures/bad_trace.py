@@ -1,0 +1,41 @@
+# SITPU-TRACE bad fixture: host-sync / retrace hazards inside traced
+# code. Parsed by the linter only — never imported or executed.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_step(cfg):
+    def step(field, cam):
+        # Python `if` on a traced comparison: trace-time error / retrace
+        if field.max() > cfg.threshold:
+            field = field * 0.5
+        # host-sync concretization of a traced value
+        peak = float(field.max())
+        # host pull inside compiled code
+        host = np.asarray(field)
+        return field + peak + host.mean()
+
+    return jax.jit(step)
+
+
+def scan_loop(frames):
+    def body(carry, _):
+        state = carry
+        # per-iteration literal re-materialization inside the scan body
+        weights = jnp.array([0.25, 0.5, 0.25])
+        state = state * weights.sum()
+        return state, state
+
+    def run(state):
+        return jax.lax.scan(body, state, None, length=frames)
+
+    return jax.jit(run)
+
+
+def bad_static(field, scale, mode):
+    return field * scale
+
+
+# names a parameter bad_static() does not have
+bad_static_jit = jax.jit(bad_static, static_argnames=("mode", "engine"))

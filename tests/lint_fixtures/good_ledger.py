@@ -1,0 +1,71 @@
+# SITPU-LEDGER good fixture: the same fallback shapes, ledgered (or
+# legitimately exempt). Parsed by the linter only.
+from scenery_insitu_tpu import obs
+
+
+def load_codec():
+    try:
+        import fastcodec
+        return fastcodec
+    except ImportError:
+        obs.degrade("fixture.codec", "fastcodec", "slowcodec",
+                    "fastcodec not installed")
+        import slowcodec
+        return slowcodec
+
+
+def pick_backend(data):
+    try:
+        result = fast_path(data)
+    except Exception as e:
+        obs.degrade("fixture.backend", "fast", "slow", str(e)[:80])
+        result = slow_path(data)
+    return result
+
+
+def have_turbo():
+    try:
+        import turbo  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def run(data):
+    if have_turbo():
+        return turbo_run(data)
+    obs.degrade("fixture.turbo", "turbo", "plain", "turbo not installed",
+                warn=False)
+    return plain_run(data)
+
+
+def strict(data):
+    # re-raising handlers propagate the failure — not a fallback
+    try:
+        return fast_path(data)
+    except Exception as e:
+        raise RuntimeError("fast path is mandatory here") from e
+
+
+def suppressed(data):
+    try:
+        return fast_path(data)
+    except Exception:  # sitpu-lint: disable=SITPU-LEDGER
+        # justified inline: covered by the caller's ledger entry
+        return slow_path(data)
+
+
+def fast_path(data):
+    return data
+
+
+def slow_path(data):
+    return data
+
+
+def turbo_run(data):
+    return data
+
+
+def plain_run(data):
+    return data

@@ -1,0 +1,52 @@
+# SITPU-PALLAS good fixture: the same kernel behind a compile probe,
+# with a divisibility guard and a (1, 1) SMEM scalar block. Parsed by
+# the linter only.
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_H = 8
+TILE_W = 128
+
+
+def _kernel(x_ref, o_ref, s_ref):
+    o_ref[...] = x_ref[...] * 2.0
+    s_ref[0, 0] = jnp.max(x_ref[...])
+
+
+def double_chunk(x):
+    h, w = x.shape
+    if h % TILE_H:
+        raise ValueError(f"height {h} not a multiple of {TILE_H}")
+    smem = pl.BlockSpec((1, 1), lambda i: (i, 0),
+                        memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        _kernel, grid=(h // TILE_H,),
+        in_specs=[pl.BlockSpec((TILE_H, w), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((TILE_H, w), lambda i: (i, 0)), smem],
+        out_shape=[jax.ShapeDtypeStruct((h, w), jnp.float32),
+                   jax.ShapeDtypeStruct((h // TILE_H, 1), jnp.float32)],
+    )(x)
+
+
+_PROBE: dict = {}
+
+
+def double_compile_ok(h: int = TILE_H, w: int = TILE_W) -> bool:
+    """One-time Mosaic-acceptance probe for `double_chunk`."""
+    key = (jax.default_backend(), int(h), int(w))
+    ok = _PROBE.get(key)
+    if ok is None:
+        try:
+            sds = jax.ShapeDtypeStruct((h, w), jnp.float32)
+            jax.jit(double_chunk).lower(sds).compile()
+            ok = True
+        except Exception:
+            from scenery_insitu_tpu import obs
+
+            obs.degrade("fixture.double_fold", "pallas", "xla",
+                        f"Mosaic rejected double_chunk at {h}x{w}")
+            ok = False
+        _PROBE[key] = ok
+    return ok

@@ -1,0 +1,34 @@
+# SITPU-THREAD good fixture: the two compliant builder shapes. Parsed by
+# the linter only.
+
+
+def distributed_obj_step(mesh, tf, vdi_cfg=None, comp_cfg=None):
+    """Whole-object threading: comp_cfg flows into the composite call —
+    every current and future knob rides along."""
+    def step(data, cam):
+        return composite_cfg(march(data, cam), comp_cfg)
+    return step
+
+
+def distributed_knob_step(mesh, tf, width, height,
+                          exchange="all_to_all", wire="f32",
+                          schedule="frame", wave_tiles=4,
+                          ring_slots=0, k_budget="static"):
+    """Explicit-knob threading: the full matrix accepted and forwarded."""
+    def step(data, cam):
+        return composite(march(data, cam), exchange=exchange, wire=wire,
+                         schedule=schedule, wave_tiles=wave_tiles,
+                         ring_slots=ring_slots, k_budget=k_budget)
+    return step
+
+
+def march(data, cam):
+    return data
+
+
+def composite(frag, **kw):
+    return frag
+
+
+def composite_cfg(frag, cfg):
+    return frag

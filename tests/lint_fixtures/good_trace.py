@@ -1,0 +1,49 @@
+# SITPU-TRACE good fixture: the same shapes written device-safe. Parsed
+# by the linter only.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_WEIGHTS = jnp.array([0.25, 0.5, 0.25])     # hoisted out of the scan
+
+
+def build_step(cfg):
+    def step(field, cam):
+        # static config branch: fine (cfg is host configuration)
+        if cfg.threshold > 0:
+            field = jnp.where(field.max() > cfg.threshold,
+                              field * 0.5, field)
+        # shape queries on traced values are trace-time constants
+        d, h, w = field.shape
+        if h % 8:
+            field = field[:, : h - h % 8]
+        # None-checks are pytree structure, not traced booleans
+        if cam is None:
+            cam = jnp.zeros((3,))
+        return field * (1.0 / (d * h * w))
+
+    return jax.jit(step)
+
+
+def scan_loop(frames):
+    def body(carry, _):
+        state = carry * _WEIGHTS.sum()
+        return state, state
+
+    def run(state):
+        return jax.lax.scan(body, state, None, length=frames)
+
+    return jax.jit(run)
+
+
+def host_report(field_host):
+    # NOT a traced context: eager host code may convert freely
+    arr = np.asarray(field_host)
+    return float(arr.mean())
+
+
+def good_static(field, scale, mode):
+    return field * scale
+
+
+good_static_jit = jax.jit(good_static, static_argnames=("mode",))

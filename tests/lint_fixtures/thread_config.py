@@ -1,0 +1,19 @@
+# SITPU-THREAD fixture config: a mini CompositeConfig whose dataclass
+# fields DERIVE the knob matrix (the checker must not hardcode knob
+# names). Parsed by the linter only.
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class CompositeConfig:
+    max_output_supersegments: int = 20
+    adaptive: bool = True
+    adaptive_iters: int = 6
+    backend: str = "auto"
+    exchange: str = "all_to_all"
+    ring_slots: int = 0
+    wire: str = "f32"
+    schedule: str = "frame"
+    wave_tiles: int = 4
+    k_budget: str = "static"
+    k_budget_min: int = 4

@@ -1,0 +1,31 @@
+# SITPU-THREAD session-plumbing fixture: one compliant call, one call
+# that forgets a knob, one that drops the config object. Parsed by the
+# linter only (the builder names resolve against the fixture pipeline).
+
+
+def build_good(sess):
+    step = distributed_knob_step(
+        sess.mesh, sess.tf, 64, 48,
+        exchange=sess.cfg.composite.exchange,
+        wire=sess.cfg.composite.wire,
+        schedule=sess.cfg.composite.schedule,
+        wave_tiles=sess.cfg.composite.wave_tiles,
+        ring_slots=sess.cfg.composite.ring_slots,
+        k_budget=sess.cfg.composite.k_budget)
+    obj = distributed_obj_step(sess.mesh, sess.tf, sess.cfg.vdi,
+                               sess.cfg.composite)
+    return step, obj
+
+
+def build_bad(sess):
+    # forgets wire= — the builder default silently masks cfg.composite.wire
+    step = distributed_knob_step(
+        sess.mesh, sess.tf, 64, 48,
+        exchange=sess.cfg.composite.exchange,
+        schedule=sess.cfg.composite.schedule,
+        wave_tiles=sess.cfg.composite.wave_tiles,
+        ring_slots=sess.cfg.composite.ring_slots,
+        k_budget=sess.cfg.composite.k_budget)
+    # never binds comp_cfg — the builder default runs, not the session's
+    obj = distributed_obj_step(sess.mesh, sess.tf)
+    return step, obj

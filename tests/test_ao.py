@@ -8,7 +8,7 @@ import pytest
 from scenery_insitu_tpu.config import RenderConfig, SliceMarchConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.transfer import for_dataset
-from scenery_insitu_tpu.core.volume import Volume, procedural_volume
+from scenery_insitu_tpu.core.volume import procedural_volume
 from scenery_insitu_tpu.ops import ao, slicer
 from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.utils.image import psnr
@@ -88,7 +88,6 @@ def test_ao_off_is_identity(scene):
 def test_distributed_ao_seam_exact_gather(scene):
     """Distributed plain render with AO (radius-deep halos) must match
     the single-device AO render — no banding at slab seams."""
-    import jax
 
     from scenery_insitu_tpu.parallel.mesh import make_mesh
     from scenery_insitu_tpu.parallel.pipeline import (distributed_plain_step,

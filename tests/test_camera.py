@@ -1,7 +1,7 @@
 import jax.numpy as jnp
 import numpy as np
 
-from scenery_insitu_tpu.core.camera import (Camera, look_at, orbit,
+from scenery_insitu_tpu.core.camera import (Camera, orbit,
                                             perspective, pixel_rays,
                                             projection_matrix, view_matrix,
                                             world_to_ndc)

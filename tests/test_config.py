@@ -1,4 +1,3 @@
-import json
 
 from scenery_insitu_tpu.config import FrameworkConfig
 

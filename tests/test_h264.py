@@ -193,7 +193,7 @@ def test_vui_timing_and_level_derivation():
 
 def test_sink_accepts_chw_rgb_and_hwc():
     from scenery_insitu_tpu.io.h264 import h264_sink as mk
-    import io as _io, tempfile, os
+    import tempfile, os
     rng = np.random.default_rng(2)
     base = rng.random((34, 46, 3)).astype(np.float32)
     outs = []

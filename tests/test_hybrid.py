@@ -12,7 +12,7 @@ import numpy as np
 from scenery_insitu_tpu.config import (CompositeConfig, SliceMarchConfig,
                                        VDIConfig)
 from scenery_insitu_tpu.core.camera import Camera
-from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
+from scenery_insitu_tpu.core.transfer import for_dataset
 from scenery_insitu_tpu.core.vdi import VDI, render_vdi_same_view
 from scenery_insitu_tpu.ops.hybrid import composite_vdi_with_particles
 from scenery_insitu_tpu.ops.splat import SplatOutput

@@ -9,9 +9,7 @@ import re
 import subprocess
 import sys
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 

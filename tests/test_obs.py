@@ -5,7 +5,6 @@ Timers windowed-dump reset."""
 import json
 import warnings
 
-import numpy as np
 import pytest
 
 from scenery_insitu_tpu import obs

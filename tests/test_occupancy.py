@@ -15,7 +15,7 @@ from jax.sharding import PartitionSpec as P
 from scenery_insitu_tpu.config import (CompositeConfig, SliceMarchConfig,
                                        VDIConfig)
 from scenery_insitu_tpu.core.camera import Camera
-from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
+from scenery_insitu_tpu.core.transfer import TransferFunction
 from scenery_insitu_tpu.core.volume import Volume
 from scenery_insitu_tpu.ops import occupancy as occ
 from scenery_insitu_tpu.ops import slicer
@@ -351,7 +351,6 @@ def test_skip_gates_bitexact_composited_8dev():
                           check_vma=False))
     sharded = shard_volume(vol.data, mesh)
     chunks_all, tiles_all = g(sharded, vol.origin, vol.spacing)
-    nchunks = chunks_all.shape[0] // n
     assert not bool(jnp.all(tiles_all)), "scene must be skippable"
 
     def step(local_data, origin, spacing, cam, occ_c, occ_t):

@@ -68,7 +68,6 @@ def test_resegment_matches_scan(shape):
 def test_composite_backend_parity_on_real_vdis():
     vol = procedural_volume(16, kind="blobs", seed=7)
     tf = TransferFunction.ramp(0.1, 0.9, 0.6)
-    cam = Camera.create((0.0, 0.0, 4.0), fov_y_deg=50.0, near=0.5, far=20.0)
     vdis = []
     for eye_x in (-0.2, 0.2):
         cam_i = Camera.create((eye_x, 0.0, 4.0), fov_y_deg=50.0,

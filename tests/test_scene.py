@@ -3,7 +3,6 @@ ghost layers must render identically to the assembled single volume —
 the seam-exactness the reference gets from OpenFPM ghosts
 (DistributedVolumeRenderer.kt:116-160)."""
 
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -13,7 +12,7 @@ from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.scene import MultiGridScene
 from scenery_insitu_tpu.core.transfer import for_dataset
 from scenery_insitu_tpu.core.vdi import render_vdi_same_view
-from scenery_insitu_tpu.core.volume import Volume, procedural_volume
+from scenery_insitu_tpu.core.volume import procedural_volume
 from scenery_insitu_tpu.ops import slicer
 from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.utils.image import psnr

@@ -41,7 +41,6 @@ def test_vortex_energy_decays():
 
 def test_sho_particles_oscillate():
     st, p = pt.sho_init(100, box=1.0)
-    com0 = np.asarray(st.pos.mean(axis=0))
     for _ in range(200):
         st = pt.sho_step(st, p)
     assert np.isfinite(np.asarray(st.pos)).all()

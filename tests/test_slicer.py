@@ -3,7 +3,6 @@ geometry, cross-engine parity with the gather-path raycaster, VDI
 generation equivalence, and edge cases (axes, signs, oblique cameras,
 out-of-frustum volumes)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

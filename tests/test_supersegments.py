@@ -79,7 +79,6 @@ def test_alpha_under_ordering():
 
 
 def test_count_matches_write():
-    import jax
     rng = np.random.default_rng(3)
     h = w = 4
     n = 24

@@ -3,7 +3,6 @@ sim stencil's guard rails, the bf16 marched-volume path, the on-device
 frame scan, and the pallas_seg argument-form/probe fixes that rode along
 (ADVICE.md round 5)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

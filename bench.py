@@ -36,6 +36,10 @@ BASELINE primary scale 512^3 x 25 frames; the CPU fallback drops to
     set SITPU_BENCH_FOLD, for fixed-fold A/B captures)
   SITPU_BENCH_SCAN_FRAMES=1  (whole frame loop in ONE lax.scan launch)
   SITPU_BENCH_SIM_STEPS=0    (render-only: static field, moving camera)
+  SITPU_BENCH_REBALANCE=even|occupancy  (render rebalancing: single-chip
+    runs have one band either way; the knob carries the config and the
+    MODELED 8-rank plan/straggler block into the artifact — the measured
+    distributed A/B is benchmarks/rank_slab_bench.py --rebalance both)
   SITPU_BENCH_SCHEDULE=frame|waves  SITPU_BENCH_WAVE_TILES=4  (tile-wave
     pipelined frames — docs/PERF.md "Tile waves"; single-chip it carries
     the config + modeled 8-rank overlap into the artifact)
@@ -263,6 +267,15 @@ def main():
     # distributed A/B is benchmarks/composite_bench.py --schedule both
     schedule = os.environ.get("SITPU_BENCH_SCHEDULE", "frame")
     wave_tiles = _env_int("SITPU_BENCH_WAVE_TILES", 4)
+    # render-rebalancing A/B (docs/PERF.md "Render rebalancing"): a
+    # single chip has one z band whatever the plan, so like the
+    # exchange/wire/schedule knobs this carries the config and the
+    # MODELED 8-rank plan + straggler factors into the artifact; the
+    # measured distributed A/B lives in benchmarks/rank_slab_bench.py
+    rebalance = os.environ.get("SITPU_BENCH_REBALANCE", "even")
+    if rebalance not in ("even", "occupancy"):
+        raise ValueError(f"SITPU_BENCH_REBALANCE must be even|occupancy, "
+                         f"got {rebalance!r}")
 
     from scenery_insitu_tpu.config import SliceMarchConfig
     from scenery_insitu_tpu.ops import slicer
@@ -294,7 +307,8 @@ def main():
                                      adaptive_iters=ad_iters,
                                      exchange=exchange, wire=wire,
                                      schedule=schedule,
-                                     wave_tiles=wave_tiles),
+                                     wave_tiles=wave_tiles,
+                                     rebalance=rebalance),
             engine=engine, grid_shape=(grid, grid, grid),
             axis_sign=slicer.choose_axis(base) if engine == "mxu" else None,
             slicer_cfg=mc, render_dtype=render_dtype, sim_fused=sim_fused,
@@ -507,6 +521,34 @@ def main():
             }
         except Exception as e:   # never let reporting kill the artifact
             occupancy_info = {"error": f"{type(e).__name__}: {e}"}
+    # render-rebalance block (post-timing, host-side, engine-agnostic):
+    # the z live profile of the FINAL benched field at the reference
+    # 8-rank shape -> the plan slice_plan would adopt and the modeled
+    # straggler factor it removes (max/mean per-rank march work; the
+    # measured distributed A/B is benchmarks/rank_slab_bench.py)
+    rebalance_info = None
+    try:
+        from scenery_insitu_tpu.core.transfer import for_dataset as _fd
+        from scenery_insitu_tpu.ops import occupancy as occ_mod
+
+        n_model = 8
+        prof = occ_mod.z_live_profile(v, _fd("gray_scott"))
+        even8 = occ_mod.even_plan(grid, n_model)
+        plan8 = occ_mod.slice_plan(prof, grid, n_model, min_depth=4,
+                                   quantum=4)
+        rebalance_info = {
+            "mode": rebalance,
+            "modeled_ranks": n_model,
+            "plan": list(plan8),
+            "plan_histogram": {str(d): sum(1 for p_ in plan8 if p_ == d)
+                               for d in sorted(set(plan8))},
+            "straggler_even": round(
+                occ_mod.straggler_factor(prof, grid, even8), 3),
+            "straggler_planned": round(
+                occ_mod.straggler_factor(prof, grid, plan8), 3),
+        }
+    except Exception as e:       # never let reporting kill the artifact
+        rebalance_info = {"error": f"{type(e).__name__}: {e}"}
     # CONFIG-MATCHED vs_baseline: fps/30 only at the 512^3 primary scale
     # on the flagship engine, null otherwise — the mxu render work scales
     # ~grid^4 and the sim ~grid^3, so no single exponent converts a
@@ -555,12 +597,14 @@ def main():
         "modeled_exchange_8rank": _mod_exchange(
             8, k, height, width, exchange, wire, schedule, wave_tiles),
         "occupancy": occupancy_info,
+        "rebalance": rebalance_info,
         "degradations": obs.ledger(),
         "config": {"grid": grid, **render_cfg,
                    "k": k, "frames": frames, "sim_steps": sim_steps,
                    "sim_fused": sim_fused, "exchange": exchange,
                    "wire": wire, "schedule": schedule,
                    "wave_tiles": wave_tiles, "skip": skip_mode,
+                   "rebalance": rebalance,
                    "adaptive_iters": ad_iters, "adaptive_mode": ad_mode,
                    "chunk": chunk, "scan_frames": bool(scan_frames),
                    "autotune_ms": autotune_ms,

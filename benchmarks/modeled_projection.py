@@ -16,6 +16,14 @@ temporal adaptive = one march/frame):
              f32 vs qpack8 wire, frame vs waves schedule — the waves row
              charges only the EXPOSED exchange bytes, docs/PERF.md
              "Tile waves")
+- rebalance: the skewed-occupancy scenario rows multiply the march term
+             by the per-rank straggler factor (max/mean march work —
+             the frame barrier is the MAX over ranks): the even split's
+             factor and the occupancy plan's come from the committed
+             rank_slab_bench A/B (rebalance_ab_r10_cpu.json), with the
+             stated assumption that the measured CPU 96^3 skew (dense
+             low-z quarter) transfers to the 512^3 banded Gray-Scott
+             regime PR 6 measured at live-cell 0.41
 - composite: the same model's stream_bytes_per_rank (merge working set
              + k_out output write)
 
@@ -84,6 +92,11 @@ def main():
     pyr_reduction = float(
         (occ.get("model") or {}).get("reduction_vs_off", {}).get("sim",
                                                                  2.43))
+    reb = _load("rebalance_ab_r10_cpu.json", {})
+    strag_even = float((reb.get("even") or {}).get("straggler_factor",
+                                                   2.88))
+    strag_plan = float((reb.get("occupancy") or {}).get(
+        "straggler_factor", 1.85))
 
     slab = (GRID // RANKS, GRID, GRID)
     slab_vox = slab[0] * slab[1] * slab[2]
@@ -152,6 +165,40 @@ def main():
             "behind march compute — only the last wave's bytes stay on "
             "the critical path"),
     ]
+    # ---- skewed-occupancy scenario (ISSUE 10): the ladder above
+    # assumes balanced bands; these two rows re-price the final stack's
+    # march term under a skewed scene — frame march = mean * straggler
+    # (max over ranks is the barrier) — first with the even split, then
+    # with the occupancy render plan. Sim stays balanced (the SIM
+    # decomposition is always the even z-slab; only the RENDER bands
+    # re-plan).
+    last = stack[-1]
+    for lever, strag, note in (
+            ("skewed_scene_even_split", strag_even,
+             f"SCENARIO row: same levers, but the scene banding makes "
+             f"the even split's densest rank the frame barrier — march "
+             f"term x{strag_even} (measured straggler factor, "
+             f"rank_slab_bench CPU A/B)"),
+            ("+render_rebalance", strag_plan,
+             f"occupancy render plan (this PR): uneven z bands re-planned "
+             f"from pyramid live fractions cut the straggler factor to "
+             f"x{strag_plan} (measured; plan recompiles bounded by "
+             f"quantum+hysteresis)")):
+        ms = dict(last["ms"])
+        ms["march"] = round(ms["march"] * strag, 2)
+        total = sum(ms.values())
+        stack.append({
+            "lever": lever,
+            "config": {**last["config"], "scenario": "skewed-occupancy",
+                       "rebalance": ("occupancy" if "rebalance" in lever
+                                     else "even"),
+                       "straggler_factor": strag},
+            "bytes": last["bytes"],
+            "ms": ms,
+            "modeled_ms_per_frame": round(total, 2),
+            "note": note,
+        })
+
     b0 = stack[0]["modeled_ms_per_frame"]
     for r_ in stack:
         r_["speedup_vs_baseline"] = round(b0 / r_["modeled_ms_per_frame"],
@@ -172,6 +219,10 @@ def main():
             "hbm_gbps": HBM_GBPS, "ici_gbps_effective": ICI_GBPS,
             "occupancy_march_reduction_source":
                 "benchmarks/results/occupancy_ab_r06_512.json (sim row)",
+            "straggler_factor_source":
+                "benchmarks/results/rebalance_ab_r10_cpu.json (measured "
+                "CPU 96^3 skewed scene; assumption: the skew transfers "
+                "to 512^3 banded Gray-Scott, PR-6 live-cell 0.41)",
             "excluded": "compute time, kernel launch/dispatch, host "
                         "fetch, fold-state traffic beyond the composite "
                         "stream model — this is a TRAFFIC lower bound; "

@@ -29,8 +29,24 @@ that needs 8 chips (the ICI all_to_all), with the assumption printed:
 Prints ONE JSON line with the pieces and two projections:
 projected_fps_v5e8 (sim + march + a2a + composite) and
 projected_render_fps_v5e8 (in-situ split: sim feeds from elsewhere).
+
+--rebalance both|even|occupancy (ISSUE 10; docs/PERF.md "Render
+rebalancing") switches the harness to the render-rebalancing A/B: on a
+SKEWED scene (live work concentrated low-z, >=4x live-fraction spread
+across rank bands) it measures every rank's band-march time under the
+even z-slab split and under the occupancy plan
+(ops/occupancy.slice_plan on the z live profile; planned bands padded
+to max(plan) exactly like mesh.reslab_z pads them), and reports the
+straggler factor (max/mean per-rank march ms) of each — the frame
+barrier is the MAX over ranks, so the straggler reduction is the frame
+speedup the rebalance buys. One chip marches the bands serially
+(band contents and shapes are exactly the distributed ones; only
+concurrency is serialized), so the per-rank times are the real
+constituents. ``--out`` writes the JSON artifact
+(rebalance_ab_r10_cpu.json is the committed CPU capture).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -60,6 +76,133 @@ def _t(fn, *args, iters=5, warmup=1):
         out = fn(*args)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters, out
+
+
+def _skewed_field(grid: int) -> "jnp.ndarray":
+    """Deterministic skewed scene: dense content in the low QUARTER of z
+    only — under the even 8-rank split, ranks 0-1 march solid live
+    chunks while ranks 2-7 march air (live-fraction spread >> 4x), the
+    regime ROADMAP item 3 left open (PR 6 measured live-cell 0.41 at
+    512^3 with exactly this kind of banding)."""
+    import numpy as np
+
+    data = np.zeros((grid, grid, grid), np.float32)
+    rng = np.random.default_rng(7)
+    lo, hi = 1, grid // 4
+    data[lo:hi] = (0.3 + 0.5 * rng.random((hi - lo, grid, grid))
+                   ).astype(np.float32)
+    return jnp.asarray(data)
+
+
+def rebalance_ab(args):
+    """Per-rank march-time A/B: even z-slab split vs the occupancy
+    plan, straggler factor (max/mean) each — the frame-barrier term."""
+    import numpy as np
+
+    from scenery_insitu_tpu.core.transfer import for_dataset
+    from scenery_insitu_tpu.ops import occupancy as occ
+
+    dev = jax.devices()[0]
+    grid = args.grid
+    n = args.ranks
+    field = _skewed_field(grid)
+    tf = for_dataset("gray_scott")
+    cam = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.5,
+                        far=20.0)
+    # the march's cost granularity IS the fold chunk: a band holding 1
+    # live slice still pays its whole chunk of resampling matmuls, so
+    # the plan quantum and the chunk must agree or the planned bands
+    # round up to chunk-sized work anyway (docs/PERF.md "Render
+    # rebalancing" — the production default ties rebalance_quantum=4 to
+    # chunked skipping the same way)
+    march_cfg = SliceMarchConfig(fold=args.fold,
+                                 chunk=max(4, args.quantum),
+                                 matmul_dtype="f32" if
+                                 dev.platform != "tpu" else "bf16")
+    vdi_cfg = VDIConfig(max_supersegments=args.k, adaptive_iters=2,
+                        adaptive_mode="histogram")
+    spec = slicer.make_spec(cam, (grid, grid, grid), march_cfg,
+                            multiple_of=n)
+
+    spacing = 2.0 / grid
+    origin = jnp.array([-1.0, -1.0, -1.0], jnp.float32)
+    spc = jnp.array([spacing] * 3, jnp.float32)
+    gmax = origin + jnp.array([grid] * 3, jnp.float32) * spc
+
+    prof = np.asarray(occ.z_live_profile(field, tf))
+    even = occ.even_plan(grid, n)
+    plan = occ.slice_plan(prof, grid, n, min_depth=args.min_depth,
+                          quantum=args.quantum)
+    band_live = [float(w) for w in occ.plan_work(prof, grid, even,
+                                                 base_cost=0.0)]
+    spread = (max(band_live) / max(min(band_live), 1e-9)
+              if min(band_live) > 0 else float("inf"))
+
+    def march_band(g0: int, depth: int, pad_to: int):
+        """Time one rank's band march through the REAL distributed
+        geometry: band volume (zero-padded to the plan max like
+        mesh.reslab_z pads it), shifted origin, global box, w_bounds
+        ownership."""
+        band = np.zeros((pad_to, grid, grid), np.float32)
+        band[:depth] = np.asarray(field[g0:g0 + depth])
+        l_origin = origin.at[2].add(g0 * spacing)
+        z_lo = origin[2] + g0 * spacing
+        z_hi = origin[2] + (g0 + depth) * spacing
+
+        @jax.jit
+        def march(data):
+            vol = Volume(data, l_origin, spc)
+            vdi, _, _ = slicer.generate_vdi_mxu(
+                vol, tf, cam, spec, vdi_cfg, box_min=origin, box_max=gmax,
+                w_bounds=(z_lo, z_hi))
+            return vdi.color, vdi.depth
+
+        dt, _ = _t(march, jnp.asarray(band), iters=args.iters)
+        return dt * 1e3
+
+    def mode_times(p):
+        pad_to = max(p)
+        starts = np.concatenate([[0], np.cumsum(p)])[:n]
+        return [march_band(int(starts[r]), int(p[r]), int(pad_to))
+                for r in range(n)]
+
+    out = {"metric": f"rebalance_ab_{grid}c_{n}ranks_{dev.platform}",
+           "unit": "straggler factor reduction (max/mean per-rank march"
+                   " ms, even / occupancy)",
+           "scene": {"grid": grid,
+                     "band_live_spread": round(spread, 2),
+                     "z_profile_bins": len(prof)},
+           "plan": list(plan),
+           "modeled": {
+               "straggler_even": round(
+                   occ.straggler_factor(prof, grid, even), 3),
+               "straggler_planned": round(
+                   occ.straggler_factor(prof, grid, plan), 3)},
+           "config": {"ranks": n, "k": args.k, "fold": spec.fold,
+                      "image": [spec.ni, spec.nj],
+                      "min_depth": args.min_depth,
+                      "quantum": args.quantum, "iters": args.iters,
+                      "platform": dev.platform,
+                      "device": dev.device_kind}}
+    for mode, p in (("even", even), ("occupancy", plan)):
+        if args.rebalance not in ("both", mode):
+            continue
+        ms = mode_times(p)
+        out[mode] = {
+            "per_rank_march_ms": [round(m, 2) for m in ms],
+            "max_ms": round(max(ms), 2),
+            "mean_ms": round(float(np.mean(ms)), 2),
+            "straggler_factor": round(max(ms) / float(np.mean(ms)), 3),
+        }
+    if "even" in out and "occupancy" in out:
+        out["value"] = round(out["even"]["straggler_factor"]
+                             / out["occupancy"]["straggler_factor"], 3)
+        out["frame_march_speedup"] = round(
+            out["even"]["max_ms"] / out["occupancy"]["max_ms"], 3)
+    print(json.dumps(out), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
 
 
 def main():
@@ -164,4 +307,31 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rebalance", choices=("both", "even", "occupancy"),
+                    default=None,
+                    help="run the render-rebalancing A/B instead of the "
+                         "legacy Config-2 projection")
+    ap.add_argument("--grid", type=int,
+                    default=int(os.environ.get("SITPU_BENCH_GRID",
+                                               "64")))
+    ap.add_argument("--ranks", type=int,
+                    default=int(os.environ.get("SITPU_BENCH_RANKS", "8")))
+    ap.add_argument("--k", type=int,
+                    default=int(os.environ.get("SITPU_BENCH_K", "8")))
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--min-depth", type=int, default=2)
+    ap.add_argument("--quantum", type=int, default=4)
+    ap.add_argument("--fold",
+                    default=os.environ.get("SITPU_BENCH_FOLD", "auto"))
+    ap.add_argument("--out", default=None)
+    cli = ap.parse_args()
+    if cli.rebalance is not None:
+        if os.environ.get("SITPU_CPU") == "1":
+            from scenery_insitu_tpu.utils.backend import pin_cpu_backend
+            pin_cpu_backend()
+        from scenery_insitu_tpu.utils.backend import enable_compile_cache
+        enable_compile_cache()
+        rebalance_ab(cli)
+    else:
+        main()

@@ -67,6 +67,15 @@ def fmt(r: dict) -> str:
                     f"  {pk}: max|dcolor|="
                     f"{r[pk].get('max_abs_diff_color')}")
         return "\n   ".join(lines)
+    if "plan" in r and "even" in r and "occupancy" in r:   # rebalance A/B
+        ev, oc = r["even"], r["occupancy"]
+        return (f"{r.get('metric', 'rebalance_ab')}: straggler "
+                f"{ev.get('straggler_factor')} -> "
+                f"{oc.get('straggler_factor')} "
+                f"(x{r.get('value')} reduction, frame march "
+                f"x{r.get('frame_march_speedup')})\n   "
+                f"  plan={r['plan']} max_ms {ev.get('max_ms')} -> "
+                f"{oc.get('max_ms')}")
     if "measured" in r and "model" in r:         # occupancy A/B
         modes = (r["measured"] or {}).get("modes", {})
         ms = " ".join(f"{m}={v.get('ms_per_frame')}ms"

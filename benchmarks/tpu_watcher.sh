@@ -23,7 +23,7 @@
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p benchmarks/results
 R=benchmarks/results
-ROUND=${SITPU_WATCHER_ROUND:-r8}
+ROUND=${SITPU_WATCHER_ROUND:-r10}
 L=/tmp/tpu_watcher_${ROUND}.log
 MAXFAIL=${SITPU_WATCHER_MAXFAIL:-2}
 DEADLINE=${SITPU_WATCHER_DEADLINE:-$(($(date +%s) + 6 * 3600))}
@@ -74,8 +74,9 @@ run_jsonl() {
   fi
 }
 
-# ---- the round-8 queue (short one-compile captures first; ROADMAP
-# item 1's per-lever hardware A/Bs + this round's waves schedule) ----
+# ---- the round queue (short one-compile captures first; ROADMAP
+# item 1's per-lever hardware A/Bs + waves + this round's render
+# rebalancing A/B) ----
 run_step() {
   case "$1" in
     # flagship 512^3, fixed default fold (the lever-stack re-capture)
@@ -124,6 +125,13 @@ run_step() {
          SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_GRID=1024 \
          SITPU_BENCH_FRAMES=5 SITPU_BENCH_PLATFORMS=tpu \
          SITPU_BENCH_CHILD_TIMEOUT=1800 python bench.py ;;
+    # render-rebalancing A/B: per-rank march straggler factor, even vs
+    # occupancy plan on a skewed 256^3 scene (docs/PERF.md "Render
+    # rebalancing"; the committed CPU capture is rebalance_ab_r10_cpu)
+    11) run_json "$R/rebalance_ab_tpu_${ROUND}.json" 1200 \
+         python benchmarks/rank_slab_bench.py --rebalance both \
+         --grid 256 --iters 3 \
+         --out "$R/rebalance_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
@@ -139,10 +147,11 @@ step_out() {
     8) echo "$R/occupancy_ab_tpu_${ROUND}_512.json" ;;
     9) echo "$R/bench_tpu_${ROUND}_512_scanloop.json" ;;
     10) echo "$R/bench_tpu_${ROUND}_1024.json" ;;
+    11) echo "$R/rebalance_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=10
+NSTEPS=11
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

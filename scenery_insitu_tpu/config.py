@@ -242,6 +242,45 @@ class CompositeConfig:
     #                memory one.
     k_budget: str = "static"
     k_budget_min: int = 4      # floor of the occupancy budget, slots
+    # Render rebalancing (docs/PERF.md "Render rebalancing"): the SIM
+    # sharding always stays the even 1-D z-slab (halo exchange, sim
+    # state untouched), but the RENDER decomposition can differ:
+    #   "even"       rank r marches slab [r*D/n, (r+1)*D/n) — the
+    #                pre-ISSUE-10 decomposition (note: the gather
+    #                engine's SAMPLE LADDER now derives from the global
+    #                box under every mode, matching single-device
+    #                sample positions — docs/PERF.md "Render
+    #                rebalancing"; the MXU engine always marched the
+    #                global slice ladder and is bit-exact vs pre-10);
+    #   "occupancy"  rank r marches a PLANNED contiguous z-slice band
+    #                (ops/occupancy.slice_plan — greedy prefix-sum
+    #                equalization of the occupancy pyramid's per-z live
+    #                work), materialized from the even shards by
+    #                parallel/mesh.reslab_z with the same seam-exact
+    #                1-voxel halo contract as halo_exchange_z. Bands pad
+    #                to the plan's max depth (static SPMD shapes; padded
+    #                slices are masked and the pyramid admits zero for
+    #                them, so skipping eats the padding). The plan is
+    #                computed host-side between frames from fetched live
+    #                fractions; a plan CHANGE recompiles the step — the
+    #                quantum + hysteresis below bound how often.
+    rebalance: str = "even"
+    # Frames between host-side re-plans under rebalance="occupancy"
+    # (runtime/session.py fetches the z live profile and re-plans every
+    # this many frames; each ADOPTED plan recompiles the step).
+    rebalance_period: int = 8
+    # Plan stability: a fresh plan is adopted only when some band
+    # boundary moves by more than this fraction of the even slab depth
+    # (D/n) — below it the previous plan is kept and nothing recompiles.
+    rebalance_hysteresis: float = 0.25
+    # Floor on any rank's planned band depth, slices. Must cover the
+    # deepest halo the step needs (1 for trilinear seams; ao_radius + 1
+    # for AO pre-shading) — parallel/mesh.reslab_z validates this and
+    # names the offending rank.
+    rebalance_min_depth: int = 4
+    # Band boundaries snap to multiples of this many slices — coarser
+    # quanta mean fewer distinct plans, fewer recompiles.
+    rebalance_quantum: int = 4
 
     def __post_init__(self):
         if self.exchange not in ("all_to_all", "ring"):
@@ -265,6 +304,21 @@ class CompositeConfig:
         if self.k_budget_min < 1:
             raise ValueError(f"k_budget_min must be >= 1, "
                              f"got {self.k_budget_min}")
+        if self.rebalance not in ("even", "occupancy"):
+            raise ValueError(f"rebalance must be 'even' or 'occupancy', "
+                             f"got {self.rebalance!r}")
+        if self.rebalance_period < 1:
+            raise ValueError(f"rebalance_period must be >= 1, "
+                             f"got {self.rebalance_period}")
+        if self.rebalance_hysteresis < 0.0:
+            raise ValueError(f"rebalance_hysteresis must be >= 0, "
+                             f"got {self.rebalance_hysteresis}")
+        if self.rebalance_min_depth < 1:
+            raise ValueError(f"rebalance_min_depth must be >= 1, "
+                             f"got {self.rebalance_min_depth}")
+        if self.rebalance_quantum < 1:
+            raise ValueError(f"rebalance_quantum must be >= 1, "
+                             f"got {self.rebalance_quantum}")
 
 
 @dataclass(frozen=True)

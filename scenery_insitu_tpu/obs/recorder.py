@@ -119,6 +119,12 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                           "budgets run",
     "occupancy.ranges_remap": "sim-fused brick ranges coarsened onto an "
                               "incommensurate canonical grid (gcd bands)",
+    "occupancy.rebalance": "render rebalancing requested where there is "
+                           "nothing to rebalance (single rank / no "
+                           "volume field); even z-slabs render",
+    "occupancy.replan": "the render z-plan changed from fetched live "
+                        "fractions; the affected steps recompile on the "
+                        "new band split",
     "occupancy.sim_ranges": "fused-stencil ranges epilogue unavailable; "
                             "lax field_ranges recompute runs",
     "occupancy.vtiles_clamp": "requested in-plane occupancy tiles exceed "

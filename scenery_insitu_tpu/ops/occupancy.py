@@ -392,3 +392,136 @@ def k_budget_target(live_frac, total_live, n_ranks: int, k: int,
     share = n_ranks * k * live_frac / total
     share = jnp.where(total > 1e-9, share, jnp.float32(k))
     return jnp.clip(share, jnp.float32(min(k_min, k)), jnp.float32(k))
+
+
+# -------------------------------------------- uneven z-slab render plans
+
+
+# Work model of one z slice (docs/PERF.md "Render rebalancing"): a live
+# slice costs 1 + base, an empty one only base — skipping makes air
+# cheap, not free (the chunk scan still iterates, the pyramid gate still
+# evaluates, padded fold chunks still close segments). The committed CPU
+# A/B (benchmarks/results/rebalance_ab_r10_cpu.json) is the measured
+# anchor for the modeled straggler factors derived from this.
+PLAN_BASE_COST = 0.05
+
+
+def z_live_profile(field: jnp.ndarray, tf, nzb: int = 0, nyb: int = 0,
+                   alpha_eps: float = 1e-5) -> jnp.ndarray:
+    """f32[nzb] per-z-brick live fraction of a scalar field ``[D, H, W]``
+    — the host-side re-plan signal of ``CompositeConfig.rebalance ==
+    "occupancy"``. One `field_ranges` sweep in data layout (no permute)
+    gated through the TF's conservative alpha bound, reduced over the
+    in-plane bricks: entry i is the fraction of (y-brick) cells in
+    z band ``[i*D/nzb, (i+1)*D/nzb)`` that can contribute opacity.
+    ``nzb``/``nyb`` default to `default_bricks`. In the distributed
+    session each rank runs this on its EVEN slab and the profiles
+    concatenate along the mesh axis into the global z profile
+    `slice_plan` consumes."""
+    d_nzb, d_nyb = default_bricks(field.shape)
+    nzb = nzb or d_nzb
+    nyb = nyb or d_nyb
+    fr = field_ranges(field, nzb, nyb)
+    cl = lambda x: jnp.clip(x, 0.0, 1.0)
+    live = tf.max_alpha_in(cl(fr.lo), cl(fr.hi)) > alpha_eps
+    return jnp.mean(live.astype(jnp.float32), axis=1)
+
+
+def _slice_work(live_profile, d: int, base_cost: float):
+    """f64[d] per-slice march work from a per-z-bin live profile
+    (``len(live_profile)`` must divide ``d``)."""
+    import numpy as np
+
+    prof = np.asarray(live_profile, np.float64).clip(0.0, None)
+    nb = prof.shape[0]
+    if nb == 0 or d % nb:
+        raise ValueError(f"live profile has {nb} bins which do not "
+                         f"divide depth {d}")
+    return np.repeat(prof, d // nb) + base_cost
+
+
+def slice_plan(live_profile, d: int, n: int, min_depth: int = 1,
+               quantum: int = 1, prev=None, hysteresis: float = 0.0,
+               base_cost: float = PLAN_BASE_COST,
+               max_depth: int = 0):
+    """Per-rank contiguous z-slice counts equalizing live march work
+    (docs/PERF.md "Render rebalancing") — host-side, numpy, static.
+
+    ``live_profile`` (f32[nb], nb | d) is the global per-z-bin live
+    fraction (`z_live_profile`, rank profiles concatenated). Greedy
+    prefix-sum equalization places band boundary r at the slice where
+    cumulative work first reaches r/n of the total, snapped to the
+    nearest ``quantum`` multiple and clamped so every band keeps
+    ``min_depth`` slices. Conservation is structural: boundaries are a
+    monotone ladder from 0 to d, so ``sum(plan) == d`` always.
+
+    ``max_depth`` caps any band's depth (0 = the default cap,
+    ``2 * ceil(d / n)``): shard_map pads every rank's band to
+    ``max(plan)``, so an unbounded plan — one rank owning a huge empty
+    region — would make EVERY rank scan (and skip) that many chunks;
+    the cap bounds the padding tax at the cost of splitting large empty
+    regions across several ranks (air is cheap to share).
+
+    ``prev``/``hysteresis`` stabilize the plan across frames: when every
+    boundary of the fresh plan is within ``hysteresis * (d / n)`` slices
+    of ``prev``'s, ``prev`` is returned UNCHANGED (object-equal), so the
+    caller can key recompiles on plan identity. Returns a tuple of n
+    ints."""
+    import numpy as np
+
+    if n < 1:
+        raise ValueError(f"need >= 1 rank, got {n}")
+    min_depth = max(1, min(int(min_depth), d // n))
+    quantum = max(1, int(quantum))
+    max_depth = int(max_depth) or 2 * (-(-d // n))
+    max_depth = max(max_depth, -(-d // n))          # keep n bands feasible
+    w = _slice_work(live_profile, d, base_cost)
+    cw = np.cumsum(w)
+    total = float(cw[-1])
+    bounds = [0]
+    for r in range(1, n):
+        target = total * r / n
+        z = int(np.searchsorted(cw, target, side="left")) + 1
+        z = int(round(z / quantum)) * quantum
+        lo = max(bounds[-1] + min_depth, d - (n - r) * max_depth)
+        hi = min(d - (n - r) * min_depth, bounds[-1] + max_depth)
+        bounds.append(int(min(max(z, lo), hi)))
+    bounds.append(d)
+    plan = tuple(int(b1 - b0) for b0, b1 in zip(bounds, bounds[1:]))
+    if prev is not None and len(prev) == n and hysteresis > 0.0:
+        pb = np.concatenate([[0], np.cumsum(np.asarray(prev, np.int64))])
+        if pb[-1] == d and np.max(np.abs(np.asarray(bounds) - pb)) \
+                <= hysteresis * d / n:
+            return tuple(int(p) for p in prev)
+    return plan
+
+
+def even_plan(d: int, n: int):
+    """The identity render plan: the even z-slab split itself."""
+    if d % n:
+        raise ValueError(f"depth {d} not divisible by {n} ranks")
+    return (d // n,) * n
+
+
+def plan_work(live_profile, d: int, plan,
+              base_cost: float = PLAN_BASE_COST):
+    """Per-rank modeled march work of a render plan under the slice work
+    model — the numerator of the straggler factor."""
+    import numpy as np
+
+    w = _slice_work(live_profile, d, base_cost)
+    if sum(plan) != d:
+        raise ValueError(f"plan {plan} does not cover depth {d}")
+    bounds = np.concatenate([[0], np.cumsum(np.asarray(plan, np.int64))])
+    return [float(w[b0:b1].sum()) for b0, b1 in zip(bounds, bounds[1:])]
+
+
+def straggler_factor(live_profile, d: int, plan,
+                     base_cost: float = PLAN_BASE_COST) -> float:
+    """max/mean per-rank modeled march work — the frame-barrier term the
+    rebalance attacks (frame time is the max over ranks; mean is the
+    perfectly-balanced floor). 1.0 = no straggler."""
+    import numpy as np
+
+    work = plan_work(live_profile, d, plan, base_cost)
+    return float(np.max(work) / max(np.mean(work), 1e-12))

@@ -43,6 +43,8 @@ def raycast(vol: Volume, tf: TransferFunction, cam: Camera,
             clip_min: Optional[jnp.ndarray] = None,
             clip_max: Optional[jnp.ndarray] = None,
             ao_field: Optional[Volume] = None,
+            sample_min: Optional[jnp.ndarray] = None,
+            sample_max: Optional[jnp.ndarray] = None,
             ) -> RaycastOutput:
     """clip_min/clip_max override the ray-clipping AABB — used by the
     distributed pipeline so a rank renders exactly its domain region while
@@ -62,7 +64,18 @@ def raycast(vol: Volume, tf: TransferFunction, cam: Camera,
     origin, dirs = pixel_rays(cam, width, height)          # [3], [3, H, W]
     box_min = vol.world_min if clip_min is None else clip_min
     box_max = vol.world_max if clip_max is None else clip_max
-    tnear, tfar = intersect_aabb(origin, dirs, box_min, box_max)
+    # sample_min/sample_max: the t ladder derives from this (global) box
+    # and clip_min/clip_max only gate ownership — every rank of a
+    # decomposed volume then marches the SAME sample positions a
+    # single-device render would, whatever the render plan (see
+    # ops/vdi_gen.generate_vdi)
+    if sample_min is None:
+        tnear, tfar = intersect_aabb(origin, dirs, box_min, box_max)
+        own = None
+    else:
+        tnear, tfar = intersect_aabb(origin, dirs, sample_min, sample_max)
+        cn, cf = intersect_aabb(origin, dirs, box_min, box_max)
+        own = (cn, jnp.maximum(cf, cn))
     hit = tfar > tnear                                     # [H, W]
     tfar = jnp.maximum(tfar, tnear)
 
@@ -82,6 +95,8 @@ def raycast(vol: Volume, tf: TransferFunction, cam: Camera,
             rgb = rgb * (1.0 - occ)[..., None]
         a = adjust_opacity(a, dt / nw)
         a = jnp.where(hit & (acc[3] < cfg.early_exit_alpha), a, 0.0)
+        if own is not None:
+            a = jnp.where((t >= own[0]) & (t < own[1]), a, 0.0)
         src = jnp.concatenate([jnp.moveaxis(rgb, -1, 0) * a[None], a[None]])
         acc = acc + (1.0 - acc[3:4]) * src
         first_t = jnp.where((first_t == jnp.inf) & (a > 1e-4), t, first_t)

@@ -551,7 +551,7 @@ def chunk_occupancy_vtiles(vol: Volume, tf: TransferFunction,
 
 def _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
                      u_bounds, v_bounds, step_scale: float = 1.0,
-                     volp=None):
+                     volp=None, w_bounds=None):
     """One write march through the fused shade+fold kernel (raw mode).
     The length/ds/ratio geometry matches slice_march's own shading
     formula INCLUDING step_scale — one implementation for both the plain
@@ -567,13 +567,14 @@ def _fused_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
     packed = slice_march(vol, tf, axcam, spec, consume,
                          psg.init_seg_packed(k, spec.nj, spec.ni),
                          u_bounds, v_bounds, step_scale=step_scale,
-                         occupancy=occ, raw=True, volp=volp)
+                         occupancy=occ, raw=True, volp=volp,
+                         w_bounds=w_bounds)
     return psg.unpack_seg_state(packed)
 
 
 def _fused_stream_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
                             u_bounds, v_bounds, step_scale: float = 1.0,
-                            volp=None):
+                            volp=None, w_bounds=None):
     """Two-phase whole-march fused fold: phase M materializes the raw
     value stream (the matmul phase, chunk-skipping intact — skipped
     chunks write -1 planes), then ONE pallas_call folds the entire
@@ -603,7 +604,7 @@ def _fused_stream_vdi_march(vol, tf, axcam, spec, threshold, k, occ,
                               (buf0, sk0, jnp.int32(0)), u_bounds,
                               v_bounds, step_scale=step_scale,
                               occupancy=occ, raw=True, raw_full_skip=True,
-                              volp=volp)
+                              volp=volp, w_bounds=w_bounds)
     packed = psg.fused_stream_fold(
         psg.init_seg_packed(k, spec.nj, spec.ni), buf, length, ratio,
         skb, skb + ds, threshold, max_k=k, chunk=c, tf=tf)
@@ -649,7 +650,8 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                 early_stop: Optional[Callable] = None, raw: bool = False,
                 raw_full_skip: bool = False,
                 shaded_compact: bool = False,
-                volp: Optional[jnp.ndarray] = None):
+                volp: Optional[jnp.ndarray] = None,
+                w_bounds=None):
     """The chunked slice march. Calls ``consume(carry, rgba [C,4,Nj,Ni],
     t0 [C,Nj,Ni], t1 [C,Nj,Ni]) -> carry`` for each chunk of slices, front
     to back, and returns the final carry.
@@ -681,6 +683,14 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     no opacity correction, no t0/t1 streams. This is the fused-kernel
     feed (ops/pallas_seg.fused_fold_chunk shades in-kernel); scalar
     volumes only.
+
+    ``w_bounds`` (an open world interval ``(w_lo, w_hi)`` on the march
+    axis) additionally drops slices whose plane lies outside it — the
+    ownership mask of a PLANNED render band (docs/PERF.md "Render
+    rebalancing"): a band volume padded to the plan's max depth marches
+    only its own slices, exactly like ``v_bounds`` owns in-plane rows.
+    Slice centers sit half a voxel inside any slice-aligned boundary, so
+    the open comparison is exact.
 
     ``shaded_compact=True`` keeps the full shading (premultiplied,
     opacity-corrected rgba) but replaces the depth planes with the
@@ -737,6 +747,8 @@ def slice_march(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
         wk = local_w0 + ks * axcam.dwm
         sk = jnp.float32(spec.sign) * (wk - ew) / axcam.zp   # depth ratios
         live = (sk > spec.s_floor) & (ks < s_total)
+        if w_bounds is not None:
+            live &= (wk > w_bounds[0]) & (wk < w_bounds[1])
 
         slices = jax.lax.dynamic_slice_in_dim(volp, ci * c, c, 0)
 
@@ -933,7 +945,8 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
                   u_bounds=None, v_bounds=None,
                   step_scale: float = 1.0,
                   occupancy=None,
-                  volp: Optional[jnp.ndarray] = None) -> RaycastOutput:
+                  volp: Optional[jnp.ndarray] = None,
+                  w_bounds=None) -> RaycastOutput:
     """Front-to-back alpha-under accumulation on the intermediate grid
     (≅ VolumeRaycaster.comp, but slice-order). Background-free premultiplied
     image + first-hit depth (ray parameter; +inf where empty). Skips
@@ -978,7 +991,8 @@ def render_slices(vol: Volume, tf: TransferFunction, axcam: AxisCamera,
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     acc, first_t = slice_march(vol, tf, axcam, spec, consume, (acc0, t0),
                                u_bounds, v_bounds, step_scale,
-                               occupancy=occ, volp=volp)
+                               occupancy=occ, volp=volp,
+                               w_bounds=w_bounds)
     return RaycastOutput(acc, first_t)
 
 
@@ -1078,6 +1092,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
                      occupancy=None, k_target=None,
                      axcam: Optional[AxisCamera] = None,
                      volp: Optional[jnp.ndarray] = None,
+                     w_bounds=None,
                      ) -> Tuple[VDI, VDIMetadata, AxisCamera]:
     """VDI generation on the MXU slice march (≅ VDIGenerator.comp +
     AccumulateVDI.comp, see ops.vdi_gen for the gather-path equivalent).
@@ -1113,7 +1128,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
-        occupancy=occ, volp=volp)
+        occupancy=occ, volp=volp, w_bounds=w_bounds)
 
     if cfg.adaptive and cfg.adaptive_mode == "temporal":
         raise ValueError(
@@ -1159,7 +1174,8 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         packed = slice_march(vol, tf, axcam, spec, consume,
                              psg.init_seg_packed(k, nj, ni),
                              u_bounds, v_bounds, occupancy=occ,
-                             shaded_compact=True, volp=volp)
+                             shaded_compact=True, volp=volp,
+                             w_bounds=w_bounds)
         color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
     elif spec.fold in ("pallas_fused", "fused_stream"):
         # shade-in-kernel: the march feeds the raw resampled value plane
@@ -1171,7 +1187,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         marcher = (_fused_stream_vdi_march if spec.fold == "fused_stream"
                    else _fused_vdi_march)
         state = marcher(vol, tf, axcam, spec, threshold, k, occ,
-                        u_bounds, v_bounds, volp=volp)
+                        u_bounds, v_bounds, volp=volp, w_bounds=w_bounds)
         color, depth = sf.seg_finalize(state)
     elif spec.fold == "seg":
         def consume(st, rgba, t0, t1):
@@ -1235,7 +1251,8 @@ def initial_threshold(vol: Volume, tf: TransferFunction, cam: Camera,
                       box_min: Optional[jnp.ndarray] = None,
                       box_max: Optional[jnp.ndarray] = None,
                       u_bounds=None, v_bounds=None,
-                      occupancy=None, k_target=None) -> ss.ThresholdState:
+                      occupancy=None, k_target=None,
+                      w_bounds=None) -> ss.ThresholdState:
     """Seed state for the temporal threshold controller ([nj, ni] maps):
     one histogram counting march on the current scene (the same pass
     adaptive_mode="histogram" runs every frame — temporal mode runs it
@@ -1248,7 +1265,7 @@ def initial_threshold(vol: Volume, tf: TransferFunction, cam: Camera,
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
-        occupancy=occ, volp=volp)
+        occupancy=occ, volp=volp, w_bounds=w_bounds)
     kt = cfg.max_supersegments if k_target is None else k_target
     thr = _histogram_threshold(march, cfg, kt,
                                spec.nj, spec.ni, spec.fold)
@@ -1266,6 +1283,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
                               occupancy=None, k_target=None,
                               axcam: Optional[AxisCamera] = None,
                               volp: Optional[jnp.ndarray] = None,
+                              w_bounds=None,
                               ) -> Tuple[VDI, VDIMetadata, AxisCamera,
                                          ss.ThresholdState]:
     """VDI generation with ONE march per frame (adaptive_mode="temporal").
@@ -1312,7 +1330,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         packed, count = slice_march(
             vol, tf, axcam, spec, consume,
             (pm.init_packed(k, nj, ni), jnp.zeros((nj, ni), jnp.int32)),
-            u_bounds, v_bounds, occupancy=occ, volp=volp)
+            u_bounds, v_bounds, occupancy=occ, volp=volp,
+            w_bounds=w_bounds)
         color, depth = ss.finalize(pm.unpack_state(packed))
     elif spec.fold in ("seg", "pallas_seg", "pallas_fused",
                        "fused_stream"):
@@ -1324,7 +1343,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
                        if spec.fold == "fused_stream"
                        else _fused_vdi_march)
             state = marcher(vol, tf, axcam, spec, thr, k, occ,
-                            u_bounds, v_bounds, volp=volp)
+                            u_bounds, v_bounds, volp=volp,
+                            w_bounds=w_bounds)
         elif spec.fold == "pallas_seg":
             length = axcam.ray_lengths()
 
@@ -1336,7 +1356,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
             packed = slice_march(vol, tf, axcam, spec, consume,
                                  psg.init_seg_packed(k, nj, ni),
                                  u_bounds, v_bounds, occupancy=occ,
-                                 shaded_compact=True, volp=volp)
+                                 shaded_compact=True, volp=volp,
+                                 w_bounds=w_bounds)
             state = psg.unpack_seg_state(packed)
         else:
             def consume(st, rgba, t0, t1):
@@ -1345,7 +1366,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
             state = slice_march(vol, tf, axcam, spec, consume,
                                 sf.init_seg_state(k, nj, ni),
                                 u_bounds, v_bounds, occupancy=occ,
-                                volp=volp)
+                                volp=volp, w_bounds=w_bounds)
         color, depth = sf.seg_finalize(state)
         count = state.cnt
     else:
@@ -1359,7 +1380,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         state, cstate = slice_march(
             vol, tf, axcam, spec, consume,
             (ss.init_state(k, nj, ni), ss.init_count(nj, ni)),
-            u_bounds, v_bounds, occupancy=occ, volp=volp)
+            u_bounds, v_bounds, occupancy=occ, volp=volp,
+            w_bounds=w_bounds)
         color, depth = ss.finalize(state)
         count = cstate.count
     next_thr = ss.update_threshold(threshold, count, kt,

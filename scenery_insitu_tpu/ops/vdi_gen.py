@@ -33,15 +33,34 @@ def generate_vdi(vol: Volume, tf: TransferFunction, cam: Camera,
                  max_steps: int = 512,
                  frame_index: int = 0,
                  clip_min: Optional[jnp.ndarray] = None,
-                 clip_max: Optional[jnp.ndarray] = None) -> Tuple[VDI, VDIMetadata]:
+                 clip_max: Optional[jnp.ndarray] = None,
+                 sample_min: Optional[jnp.ndarray] = None,
+                 sample_max: Optional[jnp.ndarray] = None
+                 ) -> Tuple[VDI, VDIMetadata]:
     """clip_min/clip_max: optional ray-clip AABB override (see
-    ops.raycast.raycast — used for halo-exact domain decomposition)."""
+    ops.raycast.raycast — used for halo-exact domain decomposition).
+
+    sample_min/sample_max: optional GLOBAL sampling AABB — the per-ray t
+    ladder derives from this box while clip_min/clip_max only gate
+    ownership, so every rank of a decomposed volume marches the SAME
+    sample positions a single-device render would (decomposition-
+    invariant sampling; docs/PERF.md "Render rebalancing" — what makes
+    the sort-last composite exact across different render plans)."""
     cfg = cfg or VDIConfig()
     k = cfg.max_supersegments
     origin, dirs = pixel_rays(cam, width, height)
     box_min = vol.world_min if clip_min is None else clip_min
     box_max = vol.world_max if clip_max is None else clip_max
-    tnear, tfar = intersect_aabb(origin, dirs, box_min, box_max)
+    if sample_min is None:
+        tnear, tfar = intersect_aabb(origin, dirs, box_min, box_max)
+        own = None
+    else:
+        tnear, tfar = intersect_aabb(origin, dirs, sample_min, sample_max)
+        cn, cf = intersect_aabb(origin, dirs, box_min, box_max)
+        # half-open ownership on the shared t ladder: the shared-plane t
+        # is the same f32 expression on both neighbor ranks, so every
+        # sample belongs to exactly one rank
+        own = (cn, jnp.maximum(cf, cn))
     hit = tfar > tnear
     tfar = jnp.maximum(tfar, tnear)
     n = max_steps
@@ -55,6 +74,8 @@ def generate_vdi(vol: Volume, tf: TransferFunction, cam: Camera,
         val = sample_volume_world(vol, jnp.moveaxis(pos, 0, -1))
         rgb, a = tf(val)
         a = jnp.where(hit, adjust_opacity(a, dt / nw), 0.0)
+        if own is not None:
+            a = jnp.where((t >= own[0]) & (t < own[1]), a, 0.0)
         rgba = jnp.concatenate([jnp.moveaxis(rgb, -1, 0) * a[None], a[None]])
         return rgba, t - 0.5 * dt, t + 0.5 * dt
 

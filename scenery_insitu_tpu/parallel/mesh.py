@@ -65,7 +65,8 @@ def halo_exchange_z(local: jnp.ndarray, axis_name: str = DEFAULT_AXIS,
         raise ValueError(
             f"halo depth {h} exceeds the {dn}-slice slab — a neighbor "
             "holds fewer slices than the halo needs (shrink ao_radius or "
-            "use fewer ranks / a deeper slab)")
+            "use fewer ranks / a deeper slab; planned render bands go "
+            "through reslab_z, whose floor is min(plan), not D//n)")
     clamp_bot = jnp.repeat(local[:1], h, axis=0)
     clamp_top = jnp.repeat(local[-1:], h, axis=0)
     if n == 1:
@@ -77,3 +78,87 @@ def halo_exchange_z(local: jnp.ndarray, axis_name: str = DEFAULT_AXIS,
     bottom = jnp.where(idx == 0, clamp_bot, from_below)
     top = jnp.where(idx == n - 1, clamp_top, from_above)
     return jnp.concatenate([bottom, local, top], axis=0)
+
+
+def validate_plan(plan, n: int, h: int = 1,
+                  knob: str = "composite.rebalance_min_depth") -> tuple:
+    """Static validation of a render z-plan (one band depth per rank).
+
+    The min-slab constraint of a planned decomposition is ``min(plan)``,
+    not ``D // n``: the shallowest band must still hold the deepest halo
+    any consumer needs (1 slice for seam-exact trilinear; ``ao_radius +
+    1`` for AO pre-shading). The diagnostic names the offending rank and
+    the knob that fixes it."""
+    plan = tuple(int(p) for p in plan)
+    if len(plan) != n:
+        raise ValueError(f"render plan has {len(plan)} bands for {n} "
+                         f"ranks")
+    if min(plan) < max(h, 1):
+        r = min(range(n), key=lambda i: plan[i])
+        raise ValueError(
+            f"render plan band of rank {r} is {plan[r]} slice(s) deep — "
+            f"below the {h}-slice halo this step needs (min-slab "
+            f"constraint is min(plan), not D//n; raise {knob} to >= {h} "
+            f"or use fewer ranks)")
+    return plan
+
+
+def reslab_z(local: jnp.ndarray, plan, axis_name: str = DEFAULT_AXIS,
+             h: int = 1) -> jnp.ndarray:
+    """Materialize this rank's PLANNED render band from the even z-slab
+    shards (docs/PERF.md "Render rebalancing"): the sim sharding stays
+    the even ``[Dn, H, W]`` split, and each rank assembles the contiguous
+    global band ``[start_r - h, start_r + plan[r] + h)`` where ``start_r
+    = sum(plan[:r])`` — with exactly `halo_exchange_z`'s boundary
+    contract (edge halos are clamped copies of the global boundary
+    slice, so distributed interpolation stays seam-exact vs a
+    single-device render).
+
+    shard_map needs one static shape per program, so every rank's band
+    pads to ``max(plan) + 2h`` rows; rows past a rank's own ``plan[r] +
+    2h`` are ZERO (the march masks them by its ownership bounds, and the
+    occupancy pyramid admits zero for padded chunks, so skipping eats
+    the padding).
+
+    Mechanism: one ``ppermute`` rotation per distinct (source − dest)
+    rank offset any band needs — near-even plans (the hysteresis/quantum
+    regime) need 2-3 hops, like the halo exchange; each received even
+    shard contributes its overlapping rows via a masked row gather. An
+    even plan reproduces ``halo_exchange_z(local, h=h)`` exactly
+    (row-for-row; tests assert equality)."""
+    import numpy as np
+
+    from scenery_insitu_tpu.utils.compat import axis_size
+    n = axis_size(axis_name)
+    plan = validate_plan(plan, n, h=h)
+    dn = local.shape[0]
+    d = dn * n
+    if sum(plan) != d:
+        raise ValueError(f"render plan {plan} covers {sum(plan)} slices "
+                         f"but the volume has {d}")
+    starts = np.concatenate([[0], np.cumsum(plan)])[:n]
+    out_depth = max(plan) + 2 * h
+    # clamped global row ladder of every dest rank's output buffer
+    lo = starts - h                                       # may be negative
+    g_all = np.clip(lo[:, None] + np.arange(out_depth)[None, :], 0, d - 1)
+    offsets = sorted({int(o) for r in range(n)
+                      for o in np.unique(g_all[r] // dn) - r})
+
+    ri = jax.lax.axis_index(axis_name)
+    g = jnp.asarray(g_all, jnp.int32)[ri]                 # [out_depth]
+    src = g // dn                                         # absolute source
+    loc = g - src * dn                                    # row within shard
+    band = jnp.asarray(plan, jnp.int32)[ri] + 2 * h       # live rows
+    live = jnp.arange(out_depth) < band
+    bshape = (out_depth,) + (1,) * (local.ndim - 1)
+    out = jnp.zeros((out_depth,) + local.shape[1:], local.dtype)
+    for o in offsets:
+        if o == 0:
+            recv = local
+        else:
+            perm = [(i, (i - o) % n) for i in range(n)]
+            recv = jax.lax.ppermute(local, axis_name, perm)
+        sel = (src == ri + o) & live
+        out = jnp.where(sel.reshape(bshape), jnp.take(recv, loc, axis=0),
+                        out)
+    return out

@@ -52,9 +52,20 @@ merge order), and the per-wave outputs land in the same W-sharded layout
 — plus the session can deliver finished column blocks to subscribers
 before the frame closes (runtime/session.py tile sinks).
 
-Decomposition is 1-D over the volume z axis with one-voxel halo exchange,
-making distributed trilinear sampling seam-exact vs a single-device render
-(tests assert PSNR, test_parallel.py).
+The SIM decomposition is 1-D over the volume z axis with one-voxel halo
+exchange, making distributed trilinear sampling seam-exact vs a
+single-device render (tests assert PSNR, test_parallel.py). The RENDER
+decomposition defaults to the same even z-slabs, but
+``CompositeConfig.rebalance = "occupancy"`` (docs/PERF.md "Render
+rebalancing") decouples it: each rank marches a PLANNED contiguous
+z-slice band (``ops/occupancy.slice_plan`` equalizes the occupancy
+pyramid's per-z live work; ``parallel/mesh.reslab_z`` materializes the
+band from the even shards with the identical halo contract), so on
+skewed scenes no rank marches air while another straggles — the
+sort-last composite is invariant to which rank rendered which region,
+and sampling is decomposition-invariant by construction (the MXU slice
+ladder and the gather engine's global sample box), so a rebalanced
+frame equals the even frame (tests/test_rebalance.py).
 """
 
 from __future__ import annotations
@@ -75,26 +86,57 @@ from scenery_insitu_tpu.core.volume import Volume
 from scenery_insitu_tpu.ops.composite import composite_plain, composite_vdis
 from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
-from scenery_insitu_tpu.parallel.mesh import halo_exchange_z
+from scenery_insitu_tpu.parallel.mesh import halo_exchange_z, reslab_z
 
 from scenery_insitu_tpu.utils.compat import shard_map
 
 
+def _plan_rank_band(plan: tuple, axis_name: str):
+    """Traced (band start, band depth) of this rank under a render plan
+    (a static tuple of per-rank z-slice counts — docs/PERF.md "Render
+    rebalancing"); helpers below pair it with `mesh.reslab_z`."""
+    import numpy as np
+    r = jax.lax.axis_index(axis_name)
+    starts = np.concatenate([[0], np.cumsum(plan)])[:len(plan)]
+    g0 = jnp.asarray(starts, jnp.int32)[r].astype(jnp.float32)
+    p_r = jnp.asarray(plan, jnp.int32)[r].astype(jnp.float32)
+    return g0, p_r
+
+
 def _local_volume_and_clip(local_data: jnp.ndarray, origin: jnp.ndarray,
                            spacing: jnp.ndarray, d_global: int,
-                           axis_name: str) -> Tuple[Volume, jnp.ndarray, jnp.ndarray]:
-    """Build this rank's halo-padded Volume and its exclusive clip AABB."""
+                           axis_name: str, plan=None
+                           ) -> Tuple[Volume, jnp.ndarray, jnp.ndarray]:
+    """Build this rank's halo-padded Volume and its exclusive clip AABB.
+
+    ``plan`` switches the RENDER decomposition from the even z-slab to
+    this rank's planned contiguous band (docs/PERF.md "Render
+    rebalancing"): the volume becomes the `mesh.reslab_z` band (padded
+    to the plan's max depth; clip bounds keep padding un-sampled) — the
+    clip AABBs still tile the global volume exactly, so the sort-last
+    composite is decomposition-invariant."""
     r = jax.lax.axis_index(axis_name)
     dn = local_data.shape[0]
-    halo = halo_exchange_z(local_data, axis_name)          # [Dn+2, H, W]
     dz = spacing[2]
-    local_origin = origin.at[2].add((r * dn - 1) * dz)
+    if plan is None:
+        halo = halo_exchange_z(local_data, axis_name)      # [Dn+2, H, W]
+        local_origin = origin.at[2].add((r * dn - 1) * dz)
+        z_lo = origin[2] + r * dn * dz
+        z_hi = origin[2] + (r + 1) * dn * dz
+    else:
+        halo = reslab_z(local_data, plan, axis_name)       # [Pmax+2, H, W]
+        g0, p_r = _plan_rank_band(plan, axis_name)
+        local_origin = origin.at[2].add((g0 - 1) * dz)
+        z_lo = origin[2] + g0 * dz
+        z_hi = origin[2] + (g0 + p_r) * dz
     vol = Volume(halo, local_origin, spacing)
     h, w = local_data.shape[1], local_data.shape[2]
     gmax = origin + jnp.array([w, h, d_global], jnp.float32) * spacing
-    clip_min = jnp.stack([origin[0], origin[1], origin[2] + r * dn * dz])
-    clip_max = jnp.stack([gmax[0], gmax[1], origin[2] + (r + 1) * dn * dz])
-    return vol, clip_min, clip_max
+    clip_min = jnp.stack([origin[0], origin[1], z_lo])
+    clip_max = jnp.stack([gmax[0], gmax[1], z_hi])
+    # the GLOBAL box: rays ladder their samples against it so sample
+    # positions are identical on every rank and under every render plan
+    return vol, clip_min, clip_max, origin, gmax
 
 
 def _exchange_columns(x: jnp.ndarray, n: int, axis_name: str) -> jnp.ndarray:
@@ -403,6 +445,56 @@ def _resolve_waves(comp_cfg, n: int, width: int, slicer_mod=None) -> bool:
     return True
 
 
+def _rebalance_build_marker(plan, n: int) -> None:
+    """Host-side trace-time marker of one rebalanced-step build
+    (docs/OBSERVABILITY.md): counts the build and records the plan's
+    shape — the slice histogram and the pad overhead every rank pays for
+    static SPMD shapes (max(plan)/mean(plan) - 1)."""
+    from scenery_insitu_tpu import obs as _obs
+
+    rec = _obs.get_recorder()
+    rec.count("rebalance_steps_built")
+    rec.event("rebalance_build", ranks=n, plan=list(plan),
+              max_depth=int(max(plan)), min_depth=int(min(plan)),
+              pad_overhead=round(
+                  int(max(plan)) * n / float(sum(plan)) - 1.0, 4))
+
+
+def _resolve_plan(comp_cfg, n: int, plan, min_halo: int = 1):
+    """Build-time resolution of a render z-plan for a step builder
+    (CompositeConfig.rebalance; docs/PERF.md "Render rebalancing").
+    Returns the validated static plan tuple, or None for the even
+    fast path: ``plan=None`` (no plan computed yet — the session passes
+    one once live fractions are known) and the literal even plan both
+    take the even-slab path — no reslab shuffle, no band padding, no
+    ownership masks beyond the pre-existing ``v_bounds``. (Note the
+    gather engine's SAMPLING semantics changed with this feature for
+    every decomposition, even splits included: its t ladder now derives
+    from the global box so sample positions match a single-device
+    render — see ops/vdi_gen.generate_vdi and docs/PERF.md "Render
+    rebalancing".) A plan without ``rebalance="occupancy"`` is a caller
+    bug, not a silent ignore."""
+    if plan is None:
+        return None
+    if comp_cfg is None:
+        rebalance = "even"
+    elif isinstance(comp_cfg, str):
+        rebalance = comp_cfg
+    else:
+        rebalance = comp_cfg.rebalance
+    if rebalance != "occupancy":
+        raise ValueError(
+            f"a render plan was passed but rebalance={rebalance!r} — "
+            f"plans are the mechanism of rebalance='occupancy'")
+    from scenery_insitu_tpu.parallel.mesh import validate_plan
+
+    plan = validate_plan(plan, n, h=min_halo)
+    if n == 1 or all(p == plan[0] for p in plan):
+        return None
+    _rebalance_build_marker(plan, n)
+    return plan
+
+
 def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
                          n: int, axis_name: str, wire: str = "f32"):
     """Ring schedule for the plain-image exchange: n-1 single-fragment
@@ -512,7 +604,8 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
                          vdi_cfg: Optional[VDIConfig] = None,
                          comp_cfg: Optional[CompositeConfig] = None,
                          max_steps: int = 256,
-                         axis_name: Optional[str] = None):
+                         axis_name: Optional[str] = None,
+                         plan=None):
     """Build the jitted distributed VDI render step.
 
     Returns ``f(vol_data f32[D, H, W] (z-sharded), origin f32[3],
@@ -537,14 +630,16 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
         _obs.degrade("occupancy.k_budget", "occupancy", "static",
                      "gather-engine distributed step has no occupancy "
                      "pyramid (mxu builders only)", warn=False)
+    plan = _resolve_plan(comp_cfg, n, plan)
 
     def step(local_data, origin, spacing, cam: Camera) -> VDI:
         d_global = local_data.shape[0] * n
-        vol, cmin, cmax = _local_volume_and_clip(local_data, origin, spacing,
-                                                 d_global, axis)
+        vol, cmin, cmax, smin, smax = _local_volume_and_clip(
+            local_data, origin, spacing, d_global, axis, plan=plan)
         vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
                               max_steps=max_steps, clip_min=cmin,
-                              clip_max=cmax)
+                              clip_max=cmax, sample_min=smin,
+                              sample_max=smax)
         return _composite_exchanged_sched(vdi.color, vdi.depth, n, axis,
                                           comp_cfg)
 
@@ -557,9 +652,10 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
 
 
 def _rank_slab(local_data, origin, spacing, spec, axis, n,
-               shade=None, shade_halo: int = 0):
+               shade=None, shade_halo: int = 0, plan=None):
     """This rank's halo-padded slab Volume + global box + ownership bounds
     for a slice march (shared by generation and threshold seeding).
+    Returns ``(vol, gmax, v_bounds, w_bounds, dims)``.
 
     ``shade``: optional per-rank volume shader (e.g. the AO pre-shader,
     ops/ao.shade_volume_ao) applied to a ``shade_halo``-deep extended
@@ -570,7 +666,16 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
 
     ``spec.render_dtype == "bf16"`` casts the marched slab to bf16 UP
     FRONT — the halo-exchange ICI bytes and every march's volume reads
-    halve; shaded (AO) slabs shade in f32 first and cast the result."""
+    halve; shaded (AO) slabs shade in f32 first and cast the result.
+
+    ``plan`` (docs/PERF.md "Render rebalancing") swaps the even slab for
+    this rank's PLANNED contiguous z band, assembled from the even
+    shards by `mesh.reslab_z` with the identical halo contract. The
+    returned ownership bounds extend to the march axis: ``v_bounds``
+    masks in-plane rows when z is the in-plane axis (x/y marches,
+    exactly as before), and ``w_bounds`` masks marched slices when z IS
+    the march axis — the band pads to the plan's max depth for static
+    SPMD shapes, and padded slices must never shade."""
     if getattr(spec, "render_dtype", "f32") == "bf16" and shade is None \
             and local_data.dtype == jnp.float32:
         local_data = local_data.astype(jnp.bfloat16)
@@ -579,6 +684,10 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
     h, w = local_data.shape[1], local_data.shape[2]
     dz = spacing[2]
     gmax = origin + jnp.array([w, h, dn * n], jnp.float32) * spacing
+    if plan is not None:
+        return _planned_slab(local_data, origin, spacing, spec, axis, n,
+                             plan=plan, shade=shade, shade_halo=shade_halo,
+                             dz=dz, gmax=gmax)
 
     if shade is not None:
         hr = shade_halo + 1
@@ -621,20 +730,81 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
         # the last rank only re-admits pos == global max, which the
         # volume-extent mask in _interp_matrix still caps
         v_bounds = (z_lo, jnp.where(r == n - 1, z_hi + dz, z_hi))
-    return vol, gmax, v_bounds, (w, h, dn * n)
+    return vol, gmax, v_bounds, None, (w, h, dn * n)
+
+
+def _planned_slab(local_data, origin, spacing, spec, axis, n,
+                  plan: tuple = (), shade=None, shade_halo=0,
+                  dz=None, gmax=None):
+    """`_rank_slab`'s planned-band twin (CompositeConfig.rebalance ==
+    "occupancy"): the march volume is this rank's contiguous z band from
+    the render plan, materialized by `mesh.reslab_z` (same seam-exact
+    halo/clamp contract as the even path, zero-padded to the plan's max
+    depth). Ownership stays exact and exclusive: x/y marches keep the
+    half-open ``v_bounds`` interval — now the BAND interval — and z
+    marches gain the ``w_bounds`` twin so padded slices shade nothing;
+    together every world sample still belongs to exactly one rank, which
+    is what makes the composite decomposition-invariant."""
+    r = jax.lax.axis_index(axis)
+    dn = local_data.shape[0]
+    h, w = local_data.shape[1], local_data.shape[2]
+    pmax = int(max(plan))
+    g0, p_r = _plan_rank_band(plan, axis)
+    z_lo = origin[2] + g0 * dz
+    z_hi = origin[2] + (g0 + p_r) * dz
+
+    if shade is not None:
+        hr = shade_halo + 1
+        ext = reslab_z(local_data, plan, axis, h=hr)
+        ext_origin = origin.at[2].add((g0 - hr) * dz)
+        shaded = shade(Volume(ext, ext_origin, spacing)).data
+        if getattr(spec, "render_dtype", "f32") == "bf16" \
+                and shaded.dtype == jnp.float32:
+            shaded = shaded.astype(jnp.bfloat16)
+        # the band start sits at a FIXED offset hr inside the extended
+        # band on every rank, so the trims below stay static; rows past
+        # a rank's own band + halo were zero going in and are masked by
+        # the ownership bounds coming out
+        z_slice = lambda lo, hi: (shaded[..., lo:hi, :, :]
+                                  if shaded.ndim == 4 else shaded[lo:hi])
+
+    if spec.axis == 2:
+        # march along z: the band's slices ARE the marched slices; the
+        # pad slices (band depth < pmax) are dropped by w_bounds exactly
+        # like v_bounds drops foreign in-plane rows on x/y marches
+        if shade is not None:
+            band = z_slice(hr, hr + pmax)
+        else:
+            band = reslab_z(local_data, plan, axis, h=0)   # [Pmax, H, W]
+        local_origin = origin.at[2].add(g0 * dz)
+        vol = Volume(band, local_origin, spacing)
+        return vol, gmax, None, (z_lo, z_hi), (w, h, dn * n)
+
+    # march along x/y: the in-plane v axis is the planned z band — halo
+    # rows for seam-exact bilinear, half-open PLAN-interval ownership
+    if shade is not None:
+        band = z_slice(hr - 1, hr + pmax + 1)              # [Pmax+2, ...]
+    else:
+        band = reslab_z(local_data, plan, axis)            # [Pmax+2, H, W]
+    local_origin = origin.at[2].add((g0 - 1) * dz)
+    vol = Volume(band, local_origin, spacing)
+    # same edge-rank slack as the even path: rank n-1 owns the global
+    # top whatever the plan (band starts are monotone)
+    v_bounds = (z_lo, jnp.where(r == n - 1, z_hi + dz, z_hi))
+    return vol, gmax, v_bounds, None, (w, h, dn * n)
 
 
 def _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
-                      axis, n, comp_cfg):
+                      axis, n, comp_cfg, plan=None):
     """Per-frame, per-rank shared state of an MXU generation: the
-    halo-exact slab, the frame's ONE occupancy pyramid, and (when
-    ``comp_cfg.k_budget == "occupancy"``) the psum-derived adaptive-K
-    target. Shared by the frame-schedule generation
-    (`_mxu_rank_generate`) and the tile-wave path
+    halo-exact slab (or planned render band, ``plan``), the frame's ONE
+    occupancy pyramid, and (when ``comp_cfg.k_budget == "occupancy"``)
+    the psum-derived adaptive-K target. Shared by the frame-schedule
+    generation (`_mxu_rank_generate`) and the tile-wave path
     (`_mxu_rank_generate_waves`) — T waves must not pay T pyramids or T
     psums."""
-    vol, gmax, v_bounds, dims = _rank_slab(local_data, origin, spacing,
-                                           spec, axis, n)
+    vol, gmax, v_bounds, w_bounds, dims = _rank_slab(
+        local_data, origin, spacing, spec, axis, n, plan=plan)
     occ_pyr = None
     k_target = None
     budgeted = comp_cfg is not None and comp_cfg.k_budget == "occupancy"
@@ -664,12 +834,12 @@ def _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
         rec.event("occupancy_kbudget_build", ranks=n,
                   k=vdi_cfg.max_supersegments,
                   k_min=comp_cfg.k_budget_min)
-    return vol, gmax, v_bounds, dims, occ_pyr, k_target
+    return vol, gmax, v_bounds, w_bounds, dims, occ_pyr, k_target
 
 
 def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
                        tf, vdi_cfg, axis, n, threshold=None,
-                       comp_cfg=None):
+                       comp_cfg=None, plan=None):
     """Per-rank slice-march VDI generation on a z-slab (shared by the
     distributed VDI and hybrid steps). Returns (vdi, meta, axcam,
     next_threshold) — the last is None unless carried temporal threshold
@@ -684,19 +854,20 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
     per-rank live fractions into shares of the N*K budget
     (occupancy.k_budget_target), so the adaptive threshold on a sparse
     slab stops chasing the same K as the densest rank."""
-    vol, gmax, v_bounds, dims, occ_pyr, k_target = _rank_frame_state(
-        local_data, origin, spacing, spec, tf, vdi_cfg, axis, n, comp_cfg)
+    vol, gmax, v_bounds, w_bounds, dims, occ_pyr, k_target = \
+        _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
+                          axis, n, comp_cfg, plan=plan)
     if threshold is None:
         vdi, meta, axcam = slicer.generate_vdi_mxu(
             vol, tf, cam, spec, vdi_cfg,
             box_min=origin, box_max=gmax, v_bounds=v_bounds,
-            occupancy=occ_pyr, k_target=k_target)
+            occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
         thr2 = None
     else:
         vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
             vol, tf, cam, spec, threshold, vdi_cfg,
             box_min=origin, box_max=gmax, v_bounds=v_bounds,
-            occupancy=occ_pyr, k_target=k_target)
+            occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
     # metadata must describe the GLOBAL volume, not this rank's slab
     meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
     return vdi, meta, axcam, thr2
@@ -704,7 +875,7 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
 
 def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
                              spec, tf, vdi_cfg, comp_cfg, axis, n,
-                             threshold=None):
+                             threshold=None, plan=None):
     """The tile-wave twin of `_mxu_rank_generate` + `_composite_exchanged`
     (CompositeConfig.schedule == "waves"; docs/PERF.md "Tile waves"):
     instead of one whole-frame march followed by one exchange, each rank
@@ -724,8 +895,9 @@ def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
     block], meta, axcam, thr')."""
     import jax.tree_util as jtu
 
-    vol, gmax, v_bounds, dims, occ_pyr, k_target = _rank_frame_state(
-        local_data, origin, spacing, spec, tf, vdi_cfg, axis, n, comp_cfg)
+    vol, gmax, v_bounds, w_bounds, dims, occ_pyr, k_target = \
+        _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
+                          axis, n, comp_cfg, plan=plan)
     t = comp_cfg.wave_tiles
     slicer.wave_block(spec.ni, n, t)       # validates the geometry
     axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
@@ -742,14 +914,14 @@ def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
             vdi, _, _ = slicer.generate_vdi_mxu(
                 vol, tf, cam, spec_w, vdi_cfg, v_bounds=v_bounds,
                 occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
-                volp=volp)
+                volp=volp, w_bounds=w_bounds)
             return (vdi.color, vdi.depth), None
         thr_w = jtu.tree_map(lambda m: slicer.wave_cols(m, n, t, w),
                              thr_full)
         vdi, _, _, thr2w = slicer.generate_vdi_mxu_temporal(
             vol, tf, cam, spec_w, thr_w, vdi_cfg, v_bounds=v_bounds,
             occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
-            volp=volp)
+            volp=volp, w_bounds=w_bounds)
         thr_full = jtu.tree_map(
             lambda m, mw: slicer.wave_update_cols(m, mw, n, t, w),
             thr_full, thr2w)
@@ -769,7 +941,8 @@ def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
 def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
                              spec, vdi_cfg: Optional[VDIConfig] = None,
                              comp_cfg: Optional[CompositeConfig] = None,
-                             axis_name: Optional[str] = None):
+                             axis_name: Optional[str] = None,
+                             plan=None):
     """Distributed sort-last VDI pipeline on the MXU slice-march engine
     (ops/slicer.py) — generation runs as banded-matmul slice resampling
     instead of per-ray gathers; the rest of the chain (width-axis column
@@ -786,11 +959,11 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
     rank, halo rows make boundary interpolation seam-exact.
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                           temporal=False)
+                           temporal=False, plan=plan)
 
 
 def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                    temporal: bool):
+                    temporal: bool, plan=None):
     """Shared builder of the MXU sort-last step (generate → column
     exchange under ``comp_cfg.exchange`` → composite), with or without
     carried temporal threshold state threaded through."""
@@ -805,18 +978,20 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
     waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
+    plan = _resolve_plan(comp_cfg, n, plan)
 
     def body(local_data, origin, spacing, cam, thr):
         if waves:
             out, meta, _, thr2 = _mxu_rank_generate_waves(
                 local_data, origin, spacing, cam, slicer, spec, tf,
-                vdi_cfg, comp_cfg, axis, n, threshold=thr)
+                vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan)
             return out, meta, thr2
         vdi, meta, _, thr2 = _mxu_rank_generate(local_data, origin,
                                                 spacing, cam, slicer, spec,
                                                 tf, vdi_cfg, axis, n,
                                                 threshold=thr,
-                                                comp_cfg=comp_cfg)
+                                                comp_cfg=comp_cfg,
+                                                plan=plan)
         return (_composite_exchanged(vdi.color, vdi.depth, n, axis,
                                      comp_cfg), meta, thr2)
 
@@ -859,7 +1034,8 @@ def _thr_state_spec(axis):
 def distributed_initial_threshold_mxu(mesh: Mesh, tf: TransferFunction,
                                       spec,
                                       vdi_cfg: Optional[VDIConfig] = None,
-                                      axis_name: Optional[str] = None):
+                                      axis_name: Optional[str] = None,
+                                      plan=None):
     """Jitted seeder for `distributed_vdi_step_mxu_temporal`: one
     histogram counting march per rank on its own slab. Returns
     ``f(vol_data (z-sharded), origin, spacing, cam) -> ThresholdState``
@@ -869,13 +1045,18 @@ def distributed_initial_threshold_mxu(mesh: Mesh, tf: TransferFunction,
     vdi_cfg = vdi_cfg or VDIConfig()
     axis = axis_name or mesh.axis_names[0]
     n = mesh.shape[axis]
+    # the seeding march must run the SAME render decomposition the step
+    # it seeds will march (no CompositeConfig here, so the mode is
+    # implied by the plan itself)
+    plan = _resolve_plan("occupancy", n, plan)
 
     def seed(local_data, origin, spacing, cam: Camera):
-        vol, gmax, v_bounds, _ = _rank_slab(local_data, origin, spacing,
-                                            spec, axis, n)
+        vol, gmax, v_bounds, w_bounds, _ = _rank_slab(
+            local_data, origin, spacing, spec, axis, n, plan=plan)
         return slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg,
                                         box_min=origin, box_max=gmax,
-                                        v_bounds=v_bounds)
+                                        v_bounds=v_bounds,
+                                        w_bounds=w_bounds)
 
     f = shard_map(seed, mesh=mesh,
                   in_specs=(P(axis, None, None), P(), P(), P()),
@@ -888,7 +1069,8 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
                                       vdi_cfg: Optional[VDIConfig] = None,
                                       comp_cfg: Optional[CompositeConfig]
                                       = None,
-                                      axis_name: Optional[str] = None):
+                                      axis_name: Optional[str] = None,
+                                      plan=None):
     """`distributed_vdi_step_mxu` with carried per-rank temporal threshold
     state (adaptive_mode="temporal": ONE march per rank per frame instead
     of counting + write — see slicer.generate_vdi_mxu_temporal).
@@ -900,7 +1082,7 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
     exchange and composite are unchanged.
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                           temporal=True)
+                           temporal=True, plan=plan)
 
 
 def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
@@ -909,7 +1091,8 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
                                 radius: float = 0.02, stamp: int = 5,
                                 colormap: str = "jet",
                                 axis_name: Optional[str] = None,
-                                temporal: bool = False):
+                                temporal: bool = False,
+                                plan=None):
     """Distributed hybrid volume+particle frame (BASELINE.md Config 5):
     z-sharded volume through the sort-last MXU VDI chain, N-sharded
     tracers through the sort-first splat chain (per-rank z-buffer,
@@ -943,6 +1126,7 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
     waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
+    plan = _resolve_plan(comp_cfg, n, plan)
 
     def body(local_data, origin, spacing, tr_pos, tr_vel, cam, thr):
         if waves:
@@ -952,11 +1136,12 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
             # same block the frame schedule composites
             comp, meta, axcam, thr2 = _mxu_rank_generate_waves(
                 local_data, origin, spacing, cam, slicer, spec, tf,
-                vdi_cfg, comp_cfg, axis, n, threshold=thr)
+                vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan)
         else:
             vdi, meta, axcam, thr2 = _mxu_rank_generate(
                 local_data, origin, spacing, cam, slicer, spec, tf,
-                vdi_cfg, axis, n, threshold=thr, comp_cfg=comp_cfg)
+                vdi_cfg, axis, n, threshold=thr, comp_cfg=comp_cfg,
+                plan=plan)
             comp = _composite_exchanged(vdi.color, vdi.depth, n, axis,
                                         comp_cfg)          # [Ko,·,Nj,Ni/n]
 
@@ -1008,7 +1193,13 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                exchange: str = "all_to_all",
                                wire: str = "f32",
                                schedule: str = "frame",
-                               wave_tiles: int = 4):
+                               wave_tiles: int = 4,
+                               rebalance: str = "even",
+                               rebalance_period: int = 8,
+                               rebalance_hysteresis: float = 0.25,
+                               rebalance_min_depth: int = 4,
+                               rebalance_quantum: int = 4,
+                               plan=None):
     """Distributed plain-image rendering on the MXU slice-march engine —
     the TPU-fast counterpart of `distributed_plain_step` (the reference's
     non-VDI mode, VolumeRaycaster.comp:94-161 composited by
@@ -1036,7 +1227,10 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
     ``schedule``/``wave_tiles`` — docs/PERF.md "Tile waves": under
     "waves" each rank `render_slices`-marches one column-block wave at a
     time while the previous wave's fragments exchange+composite, sharing
-    one permuted copy and occupancy gate per frame).
+    one permuted copy and occupancy gate per frame). The ``rebalance*``
+    knobs + ``plan`` select the uneven render z bands (docs/PERF.md
+    "Render rebalancing") exactly like the whole-object builders'
+    ``comp_cfg.rebalance``.
     """
     from scenery_insitu_tpu.ops import slicer
 
@@ -1046,10 +1240,20 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
     if spec.ni % n:
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
-    # validates schedule/wave_tiles values exactly like CompositeConfig
-    waves = _resolve_waves(CompositeConfig(schedule=schedule,
-                                           wave_tiles=wave_tiles),
-                           n, spec.ni, slicer)
+    # validates schedule/wave_tiles/rebalance_* values exactly like
+    # CompositeConfig (the plain builders carry the knob matrix
+    # explicitly; the session forwards cfg.composite.*)
+    knob_cfg = CompositeConfig(schedule=schedule, wave_tiles=wave_tiles,
+                               rebalance=rebalance,
+                               rebalance_period=rebalance_period,
+                               rebalance_hysteresis=rebalance_hysteresis,
+                               rebalance_min_depth=rebalance_min_depth,
+                               rebalance_quantum=rebalance_quantum)
+    waves = _resolve_waves(knob_cfg, n, spec.ni, slicer)
+    # a planned band must be at least as deep as the AO shade halo
+    plan = _resolve_plan(knob_cfg, n, plan,
+                         min_halo=(cfg.ao_radius + 1
+                                   if cfg.ao_strength > 0.0 else 1))
 
     # distributed AO: pre-shade each rank's slab with TF + occlusion on a
     # radius-deep halo (seam-exact — see _rank_slab's shade hook), then
@@ -1064,12 +1268,12 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
 
     def step(local_data, origin, spacing, cam: Camera):
         if ao_on:
-            vol, gmax, v_bounds, _ = _rank_slab(
+            vol, gmax, v_bounds, w_bounds, _ = _rank_slab(
                 local_data, origin, spacing, spec, axis, n,
-                shade=shade, shade_halo=cfg.ao_radius)
+                shade=shade, shade_halo=cfg.ao_radius, plan=plan)
         else:
-            vol, gmax, v_bounds, _ = _rank_slab(local_data, origin,
-                                                spacing, spec, axis, n)
+            vol, gmax, v_bounds, w_bounds, _ = _rank_slab(
+                local_data, origin, spacing, spec, axis, n, plan=plan)
         axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
                                         box_max=gmax)
         tf_r = tf if not ao_on else None
@@ -1092,7 +1296,8 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                            cfg.early_exit_alpha,
                                            v_bounds=v_bounds,
                                            step_scale=cfg.step_scale,
-                                           occupancy=occ, volp=volp)
+                                           occupancy=occ, volp=volp,
+                                           w_bounds=w_bounds)
                 return (out.image, out.depth), None
 
             img = _composite_plain_waves(
@@ -1102,7 +1307,8 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
         out = slicer.render_slices(vol, tf_r, axcam, spec,
                                    cfg.early_exit_alpha,
                                    v_bounds=v_bounds,
-                                   step_scale=cfg.step_scale)
+                                   step_scale=cfg.step_scale,
+                                   w_bounds=w_bounds)
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
                                           bg, exchange, wire), axcam
 
@@ -1122,7 +1328,13 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            exchange: str = "all_to_all",
                            wire: str = "f32",
                            schedule: str = "frame",
-                           wave_tiles: int = 4):
+                           wave_tiles: int = 4,
+                           rebalance: str = "even",
+                           rebalance_period: int = 8,
+                           rebalance_hysteresis: float = 0.25,
+                           rebalance_min_depth: int = 4,
+                           rebalance_quantum: int = 4,
+                           plan=None):
     """Build the jitted distributed plain-image render step (the reference's
     non-VDI mode: VolumeRaycaster + PlainImageCompositor,
     DistributedVolumeRenderer.kt:175-189). Returns ``f(vol_data, origin,
@@ -1137,9 +1349,16 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
     n = mesh.shape[axis]
     if width % n:
         raise ValueError(f"width {width} not divisible by mesh size {n}")
-    waves = _resolve_waves(CompositeConfig(schedule=schedule,
-                                           wave_tiles=wave_tiles),
-                           n, width)
+    knob_cfg = CompositeConfig(schedule=schedule, wave_tiles=wave_tiles,
+                               rebalance=rebalance,
+                               rebalance_period=rebalance_period,
+                               rebalance_hysteresis=rebalance_hysteresis,
+                               rebalance_min_depth=rebalance_min_depth,
+                               rebalance_quantum=rebalance_quantum)
+    waves = _resolve_waves(knob_cfg, n, width)
+    plan = _resolve_plan(knob_cfg, n, plan,
+                         min_halo=(cfg.ao_radius + 1
+                                   if cfg.ao_strength > 0.0 else 1))
 
     # rank partials must stay background-free — the background is blended
     # exactly once, by the final composite (blending it per rank would
@@ -1156,21 +1375,31 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
 
     def step(local_data, origin, spacing, cam: Camera) -> jnp.ndarray:
         d_global = local_data.shape[0] * n
-        vol, cmin, cmax = _local_volume_and_clip(local_data, origin, spacing,
-                                                 d_global, axis)
+        vol, cmin, cmax, smin, smax = _local_volume_and_clip(
+            local_data, origin, spacing, d_global, axis, plan=plan)
         ao_vol = None
         if ao_on:
             from scenery_insitu_tpu.ops import ao as _ao
 
             dn = local_data.shape[0]
             hr = cfg.ao_radius + 1
-            ext = halo_exchange_z(local_data, axis, h=hr)
+            if plan is None:
+                ext = halo_exchange_z(local_data, axis, h=hr)
+                n_keep = dn
+            else:
+                # the occlusion blur needs the radius-deep halo around
+                # the PLANNED band; the trim below keeps the band's
+                # 1-halo extent (matches vol.data row-for-row)
+                ext = reslab_z(local_data, plan, axis, h=hr)
+                n_keep = int(max(plan))
             occ = _ao.occlusion_field(
                 _ao.tf_alpha(Volume(ext, vol.origin, spacing), tf),
                 cfg.ao_radius, cfg.ao_strength)
-            ao_vol = Volume(occ[hr - 1:hr + dn + 1], vol.origin, spacing)
+            ao_vol = Volume(occ[hr - 1:hr + n_keep + 1], vol.origin,
+                            spacing)
         out = raycast(vol, tf, cam, width, height, rank_cfg,
-                      clip_min=cmin, clip_max=cmax, ao_field=ao_vol)
+                      clip_min=cmin, clip_max=cmax, ao_field=ao_vol,
+                      sample_min=smin, sample_max=smax)
         if waves:
             return _composite_plain_waves(out.image, out.depth, n, axis,
                                           cfg.background, exchange, wire,

@@ -277,6 +277,11 @@ class InSituSession:
         # while later tiles are still being fetched
         self.tile_sinks: List[Sink] = []
         self.frame_index = 0
+        # render rebalancing (docs/PERF.md "Render rebalancing"): the
+        # current planned z-band depths per rank (None = even split) and
+        # the frame of the last host-side re-plan; see _maybe_replan
+        self._plan = None
+        self._plan_frame = None
         self.orbit_rate = 0.0  # radians/frame camera sweep (benchmark mode)
         self.steering = None   # optional streaming.SteeringEndpoint
         self.on_steer: List[Callable[[dict], None]] = []  # non-camera msgs
@@ -317,6 +322,7 @@ class InSituSession:
         self._mxu_steps = {}   # regime key -> jitted distributed step
         self._mxu_thr = {}     # regime key -> temporal threshold state
         self._scan_steps = {}  # (kind, regime, block) -> scan executable
+        self._profile_fn = None  # jitted z-live-profile fetch (replan)
         self.mode = "vdi"
         if isinstance(self.sim, ParticleSimAdapter):
             # sort-first sphere rendering (≅ InVisRenderer + Head)
@@ -338,7 +344,8 @@ class InSituSession:
         elif self.cfg.runtime.generate_vdis:
             self._step = distributed_vdi_step(
                 self.mesh, self.tf, r.width, r.height,
-                self.cfg.vdi, self.cfg.composite, max_steps=r.max_steps)
+                self.cfg.vdi, self.cfg.composite, max_steps=r.max_steps,
+                plan=self._plan)
         elif self.engine == "mxu":
             # TPU plain mode: slice march + column exchange + nearest-first
             # composite on the intermediate grid, homography-warped to the
@@ -348,12 +355,19 @@ class InSituSession:
             self._step = None
         else:
             self.mode = "plain"
+            cc = self.cfg.composite
             self._step = distributed_plain_step(
                 self.mesh, self.tf, r.width, r.height, r,
-                exchange=self.cfg.composite.exchange,
-                wire=self.cfg.composite.wire,
-                schedule=self.cfg.composite.schedule,
-                wave_tiles=self.cfg.composite.wave_tiles)
+                exchange=cc.exchange,
+                wire=cc.wire,
+                schedule=cc.schedule,
+                wave_tiles=cc.wave_tiles,
+                rebalance=cc.rebalance,
+                rebalance_period=cc.rebalance_period,
+                rebalance_hysteresis=cc.rebalance_hysteresis,
+                rebalance_min_depth=cc.rebalance_min_depth,
+                rebalance_quantum=cc.rebalance_quantum,
+                plan=self._plan)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
@@ -383,6 +397,7 @@ class InSituSession:
     def render_frame(self):
         """Advance the sim and dispatch one render step (device arrays)."""
         drain_steering(self)
+        self._maybe_replan()
         with self.obs.span("sim", frame=self.frame_index,
                            kind=self.sim.kind):
             self.sim.advance(self.cfg.sim.steps_per_frame)
@@ -535,6 +550,91 @@ class InSituSession:
                 for s in self.tile_sinks:
                     s(index, payload)
 
+    # ------------------------------------------------ render rebalancing
+
+    def _replan_profile(self):
+        """Fetch the GLOBAL per-z-bin live profile of the current field
+        (host numpy) — each rank reduces its even slab in data layout
+        (ops/occupancy.z_live_profile, one sweep, no permute) and the
+        profiles concatenate along the mesh axis. The jitted reduction
+        is cached until the TF or steps change (_build_steps resets)."""
+        from jax.sharding import PartitionSpec as P
+
+        from scenery_insitu_tpu.ops import occupancy as _occ
+        from scenery_insitu_tpu.utils.compat import shard_map
+
+        if self._profile_fn is None:
+            axis = self.mesh.axis_names[0]
+            n = self.mesh.shape[axis]
+            tf = self.tf
+            dn = int(self.sim.field.shape[0]) // n
+            nzb = _occ._cap_divisor(dn, 32)
+
+            def prof(local):
+                return _occ.z_live_profile(local, tf, nzb=nzb)
+
+            self._profile_fn = jax.jit(shard_map(
+                prof, mesh=self.mesh, in_specs=P(axis, None, None),
+                out_specs=P(axis), check_vma=False))
+        field = shard_volume(self.sim.field, self.mesh)
+        return np.asarray(self._profile_fn(field))
+
+    def _maybe_replan(self) -> None:
+        """Host-side re-plan of the RENDER z decomposition
+        (CompositeConfig.rebalance == "occupancy"; docs/PERF.md "Render
+        rebalancing"), every ``rebalance_period`` frames: fetch the live
+        profile, run ops/occupancy.slice_plan (quantum + hysteresis keep
+        the plan stable), and when the plan actually CHANGES, drop the
+        compiled steps so the next dispatch rebuilds them on the new
+        band split — one recompile per adopted plan, minted on the
+        fallback ledger (occupancy.replan) with a ``rebalance_plan``
+        event carrying the slice histogram and modeled straggler
+        factors."""
+        cc = self.cfg.composite
+        if cc.rebalance != "occupancy":
+            return
+        n = self.mesh.shape[self.mesh.axis_names[0]]
+        if self.mode == "particles" or not hasattr(self.sim, "field") \
+                or n == 1:
+            # configured-but-inert knob: say so once instead of silently
+            # rendering even splits forever
+            _obs.degrade(
+                "occupancy.rebalance", "occupancy", "even",
+                ("single-rank mesh has one band" if n == 1 else
+                 f"mode {self.mode!r} renders no volume field to "
+                 "rebalance"), warn=False)
+            return
+        if self._plan_frame is not None and \
+                self.frame_index - self._plan_frame < cc.rebalance_period:
+            return
+        from scenery_insitu_tpu.ops import occupancy as _occ
+
+        d = int(self.sim.field.shape[0])
+        with self.obs.span("replan", frame=self.frame_index):
+            profile = self._replan_profile()
+            even = _occ.even_plan(d, n)
+            prev = self._plan if self._plan is not None else even
+            plan = _occ.slice_plan(
+                profile, d, n, min_depth=cc.rebalance_min_depth,
+                quantum=cc.rebalance_quantum, prev=prev,
+                hysteresis=cc.rebalance_hysteresis)
+        self._plan_frame = self.frame_index
+        if plan == prev:
+            return                      # stable — nothing recompiles
+        self.obs.count("rebalance_replans")
+        self.obs.event(
+            "rebalance_plan", frame=self.frame_index, plan=list(plan),
+            straggler_even=round(_occ.straggler_factor(profile, d, even),
+                                 3),
+            straggler_planned=round(_occ.straggler_factor(profile, d,
+                                                          plan), 3))
+        _obs.degrade("occupancy.replan", f"plan{tuple(prev)}",
+                     f"plan{tuple(plan)}",
+                     "render bands re-planned from fetched live "
+                     "fractions; affected steps recompile", warn=False)
+        self._plan = plan if plan != even else None
+        self._build_steps()
+
     def _enter_regime(self, key) -> None:
         if key != getattr(self, "_last_regime_key", key):
             self.obs.count("regime_switches")
@@ -581,13 +681,14 @@ class InSituSession:
                 if self._temporal:
                     step = distributed_vdi_step_mxu_temporal(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        self.cfg.composite)
+                        self.cfg.composite, plan=self._plan)
                     seed = distributed_initial_threshold_mxu(
-                        self.mesh, self.tf, spec, self.cfg.vdi)
+                        self.mesh, self.tf, spec, self.cfg.vdi,
+                        plan=self._plan)
                 else:
                     step = distributed_vdi_step_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        self.cfg.composite)
+                        self.cfg.composite, plan=self._plan)
                     seed = None
             steps_per_frame = self.cfg.sim.steps_per_frame
             mesh_n = self.mesh.shape[self.cfg.mesh.axis_name]
@@ -627,6 +728,7 @@ class InSituSession:
             while done < frames:
                 block = min(self.cfg.runtime.scan_frames, frames - done)
                 drain_steering(self)
+                self._maybe_replan()
                 # host replay of the block's camera ladder — frame i of
                 # the scan renders with exactly this camera (orbit is
                 # applied identically in-scan)
@@ -822,9 +924,10 @@ class InSituSession:
             step = distributed_hybrid_step_mxu(
                 self.mesh, self.tf, spec, self.cfg.vdi, self.cfg.composite,
                 radius=self.cfg.sim.particle_radius * float(self._spacing[0]),
-                stamp=5, temporal=self._temporal)
+                stamp=5, temporal=self._temporal, plan=self._plan)
             seed = (distributed_initial_threshold_mxu(
-                        self.mesh, self.tf, spec, self.cfg.vdi)
+                        self.mesh, self.tf, spec, self.cfg.vdi,
+                        plan=self._plan)
                     if self._temporal else None)
             r = self.cfg.render
             slicer = self._slicer
@@ -875,12 +978,19 @@ class InSituSession:
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
+            cc = self.cfg.composite
             step = distributed_plain_step_mxu(
                 self.mesh, self.tf, spec, self.cfg.render,
-                exchange=self.cfg.composite.exchange,
-                wire=self.cfg.composite.wire,
-                schedule=self.cfg.composite.schedule,
-                wave_tiles=self.cfg.composite.wave_tiles)
+                exchange=cc.exchange,
+                wire=cc.wire,
+                schedule=cc.schedule,
+                wave_tiles=cc.wave_tiles,
+                rebalance=cc.rebalance,
+                rebalance_period=cc.rebalance_period,
+                rebalance_hysteresis=cc.rebalance_hysteresis,
+                rebalance_min_depth=cc.rebalance_min_depth,
+                rebalance_quantum=cc.rebalance_quantum,
+                plan=self._plan)
             r = self.cfg.render
             slicer = self._slicer
 
@@ -920,9 +1030,10 @@ class InSituSession:
             if self._temporal:
                 inner = distributed_vdi_step_mxu_temporal(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    self.cfg.composite)
+                    self.cfg.composite, plan=self._plan)
                 seed = distributed_initial_threshold_mxu(
-                    self.mesh, self.tf, spec, self.cfg.vdi)
+                    self.mesh, self.tf, spec, self.cfg.vdi,
+                    plan=self._plan)
 
                 def step(field, origin, spacing, cam,
                          _regime=regime, _inner=inner, _seed=seed):
@@ -935,7 +1046,7 @@ class InSituSession:
             else:
                 step = distributed_vdi_step_mxu(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    self.cfg.composite)
+                    self.cfg.composite, plan=self._plan)
             self._mxu_steps[regime] = step
         return step
 

@@ -101,7 +101,9 @@ class TestThread:
             ROOT, [os.path.join(ROOT, "scenery_insitu_tpu", "config.py")])
         knobs = TH.derive_knobs(srcs[0])
         assert set(knobs) == {"exchange", "ring_slots", "wire", "schedule",
-                              "wave_tiles", "k_budget"}
+                              "wave_tiles", "k_budget", "rebalance",
+                              "rebalance_period", "rebalance_hysteresis",
+                              "rebalance_min_depth", "rebalance_quantum"}
 
     def test_deleted_wire_forwarding_fails(self):
         """The acceptance-criteria demo: a builder whose wire= forwarding

@@ -340,8 +340,8 @@ def test_skip_gates_bitexact_composited_8dev():
                             multiple_of=n)
 
     def gates(local_data, origin, spacing):
-        svol, _, _, _ = _rank_slab(local_data, origin, spacing, spec,
-                                   axis, n)
+        svol, _, _, _, _ = _rank_slab(local_data, origin, spacing, spec,
+                                      axis, n)
         pyr = occ.pyramid_from_volume(svol, tf, spec)
         return pyr.chunks, pyr.tiles
 
@@ -354,8 +354,8 @@ def test_skip_gates_bitexact_composited_8dev():
     assert not bool(jnp.all(tiles_all)), "scene must be skippable"
 
     def step(local_data, origin, spacing, cam, occ_c, occ_t):
-        svol, gmax, v_bounds, _ = _rank_slab(local_data, origin, spacing,
-                                             spec, axis, n)
+        svol, gmax, v_bounds, _, _ = _rank_slab(local_data, origin,
+                                                spacing, spec, axis, n)
         vdi, _, _ = slicer.generate_vdi_mxu(
             svol, tf, cam, spec, vdi_cfg, box_min=origin, box_max=gmax,
             v_bounds=v_bounds, occupancy=(occ_c, occ_t))

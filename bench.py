@@ -741,7 +741,10 @@ def _orchestrate():
             # bounded exponential backoff between platform probes: a
             # tunnel mid-flap gets a real chance to recover before the
             # retry probe instead of two back-to-back identical failures
-            delay = min(5 * 2 ** (i - 1), 30)
+            # (shared ladder: utils/retry.py, same pacing the stream
+            # endpoints use to reconnect)
+            from scenery_insitu_tpu.utils.retry import backoff_delay
+            delay = backoff_delay(i - 1, base_s=5.0, cap_s=30.0)
             print(f"[bench] backing off {delay}s before {platform} "
                   f"attempt {attempts[platform]}", file=sys.stderr,
                   flush=True)

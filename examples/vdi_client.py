@@ -50,12 +50,20 @@ def main():
         steer = SteeringPublisher(args.steer)
 
     print(f"listening on {args.connect} …", flush=True)
-    for i in range(args.frames):
+    from scenery_insitu_tpu.runtime.streaming import StreamDrop
+    i = 0
+    while i < args.frames:
         got = sub.receive(timeout_ms=int(args.timeout * 1000))
         if got is None:
             print(f"no VDI within {args.timeout:.0f} s; is a producer "
                   "publishing?", flush=True)
             sys.exit(2)
+        if isinstance(got, StreamDrop):
+            # corrupt/stale message refused by the integrity layer
+            # (docs/ROBUSTNESS.md) — wait for the next good frame
+            # WITHOUT burning one of the --frames budget
+            print(f"dropped {got.kind} message: {got.reason}", flush=True)
+            continue
         vdi, meta = got
         # rebuild the generating camera's slice geometry from METADATA ONLY
         spec0 = vdi_novel.axis_spec_from_meta(meta)
@@ -76,6 +84,7 @@ def main():
             from scenery_insitu_tpu.runtime.streaming import (
                 make_camera_message)
             steer.send(make_camera_message(novel))
+        i += 1
     sub.close()
 
 

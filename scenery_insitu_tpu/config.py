@@ -408,6 +408,59 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class FaultConfig:
+    """Self-healing delivery-plane knobs (docs/ROBUSTNESS.md): liveness
+    deadlines, reconnect backoff, sink quarantine and the tile-frame
+    assembler window. Every seam where bytes cross a failure domain
+    (zmq VDI/steering streams, the UDP video stream, the shm ingest
+    ring, in-process sinks) reads its tolerance from here."""
+
+    # Publishers emit a lightweight heartbeat when idle this long, so
+    # subscribers can tell "no frames" from "dead peer"
+    # (VDIPublisher.maybe_heartbeat / SteeringPublisher.heartbeat).
+    heartbeat_period_s: float = 2.0
+    # A subscriber/endpoint that has seen NO traffic (frames, tiles or
+    # heartbeats) for this long considers the peer lost and reconnects
+    # with bounded exponential backoff (utils/retry.py). <= 0 disables
+    # liveness supervision.
+    liveness_timeout_s: float = 10.0
+    # Reconnect backoff ladder: base * 2**attempt seconds, capped.
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 30.0
+    # A frame/tile sink or on_steer callback failing this many
+    # CONSECUTIVE times is quarantined (disabled + `session.sink`
+    # ledger) instead of killing the render loop; a success in between
+    # resets the count (runtime/failsafe.SinkGuard).
+    max_sink_failures: int = 3
+    # FrameAssembler: an incomplete tile frame is abandoned (ledgered
+    # `stream.gap`) once this many NEWER frames have started — the
+    # `VideoReceiver._parts` eviction pattern, generalized.
+    assembler_window: int = 4
+    # Steering messages larger than this are dropped before unpack (the
+    # steering socket is network-facing; a hostile/buggy viewer must
+    # not be able to balloon the renderer).
+    max_message_bytes: int = 1 << 20
+
+    def __post_init__(self):
+        if self.heartbeat_period_s <= 0:
+            raise ValueError(f"heartbeat_period_s must be > 0, "
+                             f"got {self.heartbeat_period_s}")
+        if self.backoff_base_s <= 0 or self.backoff_cap_s < self.backoff_base_s:
+            raise ValueError(
+                f"need 0 < backoff_base_s <= backoff_cap_s, got "
+                f"{self.backoff_base_s}, {self.backoff_cap_s}")
+        if self.max_sink_failures < 1:
+            raise ValueError(f"max_sink_failures must be >= 1, "
+                             f"got {self.max_sink_failures}")
+        if self.assembler_window < 1:
+            raise ValueError(f"assembler_window must be >= 1, "
+                             f"got {self.assembler_window}")
+        if self.max_message_bytes < 1024:
+            raise ValueError(f"max_message_bytes must be >= 1024, "
+                             f"got {self.max_message_bytes}")
+
+
+@dataclass(frozen=True)
 class StreamConfig:
     """Steering / streaming endpoints (≅ ZMQ :6655 + UDP :3337,
     VolumeFromFileExample.kt:840-854; DistributedVolumeRenderer.kt:278-283)."""
@@ -429,6 +482,7 @@ class FrameworkConfig:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    fault: FaultConfig = field(default_factory=FaultConfig)
 
     # ------------------------------------------------------------------ IO
     def to_dict(self) -> dict:

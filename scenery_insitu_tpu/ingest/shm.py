@@ -326,6 +326,7 @@ class ShmShardedVolumeSource:
         self._held = [None] * n        # newest (frame, seq) seen per rank
         self._field = None
         self.last_seqs: Tuple[int, ...] = ()
+        self.stalled = False
 
     def _refresh(self, wait_ms: int) -> None:
         for r, con in enumerate(self.consumers):
@@ -343,8 +344,14 @@ class ShmShardedVolumeSource:
 
     def advance(self, n: int = 1) -> None:   # n meaningless for external
         import time
+
+        from scenery_insitu_tpu import obs as _obs
+
+        # while stalled, one non-blocking refresh pass per advance (same
+        # policy as ShmVolumeSource: a dead producer set must not
+        # throttle the render loop to one frame per timeout)
         wait_ms = (self.timeout_ms if self._field is None
-                   else self.frame_timeout_ms)
+                   else 0 if self.stalled else self.frame_timeout_ms)
         deadline = time.monotonic() + wait_ms / 1000.0
         first = True
         while True:
@@ -364,9 +371,26 @@ class ShmShardedVolumeSource:
                         self._jax.make_array_from_single_device_arrays(
                             self.global_shape, self.sharding, arrs)
                     self.last_seqs = seqs
+                    if self.stalled:
+                        self.stalled = False
+                        _obs.get_recorder().count(
+                            "ingest_stall_recoveries")
+                        _obs.get_recorder().event(
+                            "ingest_recovered",
+                            seqs=[int(s) for s in seqs])
                     return
             if time.monotonic() > deadline:
                 if self._field is not None:
+                    if not self.stalled:
+                        self.stalled = True
+                        _obs.get_recorder().count("ingest_stalls")
+                        _obs.degrade(
+                            "ingest.stall", "live producer frames",
+                            "re-rendering last-good frame",
+                            "no strictly-newer coherent shm frame set "
+                            f"within frame_timeout_ms="
+                            f"{self.frame_timeout_ms}; a producer "
+                            "stalled or died", warn=False)
                     return                     # keep rendering last frame
                 held = [None if h is None else h[1] for h in self._held]
                 raise TimeoutError(
@@ -392,26 +416,62 @@ class ShmShardedVolumeSource:
 class ShmVolumeSource:
     """Session sim-adapter over a shm channel: ``advance(n)`` pulls the
     newest frame (blocking until one arrives), ``.field`` is the device
-    array. Plugs an EXTERNAL simulation into InSituSession."""
+    array. Plugs an EXTERNAL simulation into InSituSession.
+
+    Stall supervision (docs/ROBUSTNESS.md): when no strictly-newer frame
+    arrives within ``frame_timeout_ms`` (default: ``timeout_ms``) the
+    source marks itself STALLED — minted once per episode on the
+    ``ingest.stall`` ledger — and keeps rendering the last-good frame;
+    while stalled, ``advance`` polls without blocking so a dead producer
+    cannot throttle the render loop to one frame per timeout. The
+    moment frames resume the stall clears (``ingest_stall_recoveries``
+    counter + ``ingest_recovered`` event)."""
 
     def __init__(self, channel: str, grid: Sequence[int],
-                 timeout_ms: int = 10000, device_put: bool = True):
+                 timeout_ms: int = 10000, device_put: bool = True,
+                 frame_timeout_ms: Optional[int] = None):
         import jax
 
         self.kind = "external"
         self.consumer = ShmConsumer(channel, grid, timeout_ms=timeout_ms)
         self.timeout_ms = timeout_ms
+        self.frame_timeout_ms = (timeout_ms if frame_timeout_ms is None
+                                 else frame_timeout_ms)
         self._device_put = device_put
         self._jax = jax
         self._field = None
+        self.stalled = False
+        self.stall_count = 0
+        self.last_seq = None
 
     def advance(self, n: int) -> None:   # n is meaningless for external sims
-        got = self.consumer.latest(timeout_ms=self.timeout_ms)
+        from scenery_insitu_tpu import obs as _obs
+
+        # while stalled, poll non-blocking: the loop keeps pacing on
+        # last-good data instead of stalling frame_timeout_ms per frame
+        wait = (self.timeout_ms if self._field is None
+                else 0 if self.stalled else self.frame_timeout_ms)
+        got = self.consumer.latest(timeout_ms=wait)
         if got is None:
             if self._field is None:
                 raise TimeoutError("no frame from external simulation")
+            if not self.stalled:
+                self.stalled = True
+                self.stall_count += 1
+                _obs.get_recorder().count("ingest_stalls")
+                _obs.degrade(
+                    "ingest.stall", "live producer frames",
+                    "re-rendering last-good frame",
+                    f"no strictly-newer shm frame within "
+                    f"frame_timeout_ms={self.frame_timeout_ms}; "
+                    "producer stalled or dead", warn=False)
             return                        # keep rendering the last frame
-        frame, _ = got
+        frame, seq = got
+        if self.stalled:
+            self.stalled = False
+            _obs.get_recorder().count("ingest_stall_recoveries")
+            _obs.get_recorder().event("ingest_recovered", seq=int(seq))
+        self.last_seq = seq
         self._field = (self._jax.device_put(frame) if self._device_put
                        else frame)
 

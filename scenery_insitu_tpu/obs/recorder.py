@@ -112,6 +112,12 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                           "(deprecation note in the reason)",
     "core.dataset_tf": "unknown dataset name; the generic gray-ramp "
                        "transfer function renders instead of a tuned one",
+    "head.rank_down": "head node: a render rank went silent past "
+                      "stale_frames; frames composite without it "
+                      "(degraded flag) until it returns",
+    "ingest.stall": "shm ingest: no strictly-newer producer frame past "
+                    "frame_timeout_ms; the session keeps rendering the "
+                    "last-good frame until frames resume",
     "io.vdi_codec": "zstd codec unavailable; VDI IO degrades to stdlib "
                     "zlib",
     "occupancy.k_budget": "occupancy K budgets requested where no "
@@ -145,8 +151,22 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                           "(regime change or steering drain)",
     "session.scan_frames": "scan_frames configured but unsupported in "
                            "this mode; eager loop runs",
+    "session.sink": "a frame/tile sink or on_steer callback failed "
+                    "max_sink_failures consecutive times and is "
+                    "quarantined (disabled) for the rest of the run",
     "sim.fused_stencil": "fused Pallas stencil unavailable; XLA roll "
                          "formulation advances the sim",
+    "stream.gap": "VDI stream continuity: a sequence gap, duplicate/"
+                  "reordered message, publisher restart, or a tile "
+                  "frame abandoned incomplete past the assembler window",
+    "stream.integrity": "a corrupt/truncated stream message failed "
+                        "checksum/size/shape validation and was dropped "
+                        "before decode",
+    "stream.liveness": "a stream endpoint saw no traffic past "
+                       "liveness_timeout_s and is reconnecting with "
+                       "bounded exponential backoff",
+    "stream.steering": "a malformed or oversized steering message was "
+                       "dropped; the drain keeps going",
     "sim.stencil_schedule": "Mosaic rejected every probed stencil "
                             "schedule candidate for this grid/T",
 }

@@ -1,0 +1,91 @@
+"""Per-callable failure isolation for the delivery plane
+(docs/ROBUSTNESS.md "Session failure isolation").
+
+A frame sink, tile sink or ``on_steer`` callback lives in the same
+process as the render loop but on the other side of a failure domain:
+its bugs are not the session's bugs, and an exception inside one must
+not abort an hours-long in-situ run. ``SinkGuard`` catches per callable,
+counts CONSECUTIVE failures, and quarantines (disables + ``session.sink``
+ledger) any callable that fails ``max_failures`` times in a row — a
+success in between resets the count, so a transiently failing sink (disk
+briefly full, socket mid-reconnect) keeps running.
+
+jax-free on purpose: ``runtime/head.py`` (transport + numpy only) uses
+the same guard for its sinks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from scenery_insitu_tpu import obs as _obs
+
+
+def _name_of(fn: Callable) -> str:
+    return getattr(fn, "__qualname__",
+                   getattr(fn, "__name__", type(fn).__name__))
+
+
+class SinkGuard:
+    """Failure-isolation wrapper around a list of callables the render
+    loop must survive. State is keyed on the callable's identity, so the
+    public sink lists (``sess.sinks`` / ``sess.tile_sinks`` /
+    ``sess.on_steer``) stay plain lists users append to."""
+
+    def __init__(self, max_failures: int = 3, log=None,
+                 domain: str = "session"):
+        if max_failures < 1:
+            raise ValueError(f"max_failures must be >= 1, "
+                             f"got {max_failures}")
+        self.max_failures = max_failures
+        self.log = log or (lambda s: None)
+        self.domain = domain
+        # state is keyed on id(fn) but each entry HOLDS the callable:
+        # the strong reference pins the object alive, so a freed sink's
+        # address can never be recycled into another callable's
+        # quarantine/failure record
+        self._failures = {}        # id(fn) -> (count, fn)
+        self._quarantined = {}     # id(fn) -> fn
+        self.quarantined_names = []
+
+    def is_quarantined(self, fn: Callable) -> bool:
+        return id(fn) in self._quarantined
+
+    def call(self, fn: Callable, *args, kind: str = "sink") -> bool:
+        """Run ``fn(*args)`` inside the guard; returns True on success,
+        False when it failed or is quarantined. Never raises."""
+        key = id(fn)
+        if key in self._quarantined:
+            return False
+        try:
+            fn(*args)
+        except Exception as e:
+            n = self._failures.get(key, (0, fn))[0] + 1
+            self._failures[key] = (n, fn)
+            rec = _obs.get_recorder()
+            rec.count("sink_failures")
+            name = _name_of(fn)
+            self.log(f"{kind} {name!r} failed "
+                     f"({n}/{self.max_failures}): {e!r}")
+            if n >= self.max_failures:
+                self._quarantined[key] = fn
+                self.quarantined_names.append(name)
+                rec.count("sinks_quarantined")
+                _obs.degrade(
+                    "session.sink", f"{kind} {name}", "quarantined",
+                    f"failed {self.max_failures} consecutive times in "
+                    f"{self.domain}; disabled for the rest of the run",
+                    warn=False)
+            return False
+        self._failures.pop(key, None)   # consecutive failures only
+        return True
+
+    def run(self, fns: Iterable[Callable], *args,
+            kind: str = "sink") -> int:
+        """Run every callable in ``fns`` against ``args``; returns how
+        many succeeded. Quarantined entries are skipped silently."""
+        ok = 0
+        for fn in list(fns):
+            if self.call(fn, *args, kind=kind):
+                ok += 1
+        return ok

@@ -25,6 +25,7 @@ from scenery_insitu_tpu.config import FrameworkConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.scene import MultiGridScene
 from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
+from scenery_insitu_tpu.runtime.failsafe import SinkGuard
 
 Sink = Callable[[int, dict], None]
 
@@ -49,6 +50,10 @@ class SceneSession:
         self.camera = camera or Camera.create(
             (0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.3, far=20.0)
         self.sinks: List[Sink] = list(sinks)
+        # same per-callable failure isolation as InSituSession (sinks +
+        # on_steer run behind the guard; see drain_steering)
+        self._sink_guard = SinkGuard(self.cfg.fault.max_sink_failures,
+                                     log=self.log)
         self.frame_index = 0
         self.orbit_rate = 0.0
         self.steering = None
@@ -139,8 +144,7 @@ class SceneSession:
                 payload = {"image": np.asarray(out)}
             payload["frame"] = self.frame_index
         with self.obs.span("sinks", frame=self.frame_index):
-            for s in self.sinks:
-                s(self.frame_index, payload)
+            self._sink_guard.run(self.sinks, self.frame_index, payload)
         advance_camera_and_index(self)
         self.timers.frame_done()
         # the driver paces this loop (no run() bracket to flush at), so

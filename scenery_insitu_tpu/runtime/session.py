@@ -35,6 +35,7 @@ from scenery_insitu_tpu.parallel.mesh import make_mesh
 from scenery_insitu_tpu.parallel.pipeline import (distributed_plain_step,
                                                   distributed_vdi_step,
                                                   shard_volume)
+from scenery_insitu_tpu.runtime.failsafe import SinkGuard
 from scenery_insitu_tpu.sim import grayscott as gs
 from scenery_insitu_tpu.sim import vortex as vx
 
@@ -44,7 +45,12 @@ Sink = Callable[[int, dict], None]
 def drain_steering(sess) -> None:
     """Apply all pending steering messages to ``sess`` (camera updates in
     place, other kinds to the on_steer callbacks). Shared by InSituSession
-    and SceneSession so the steering protocol has ONE consumer."""
+    and SceneSession so the steering protocol has ONE consumer.
+
+    on_steer callbacks run behind the session's SinkGuard: an exception
+    in one callback must not kill the drain (or the run) — a callback
+    failing ``fault.max_sink_failures`` consecutive times is quarantined
+    on the ``session.sink`` ledger."""
     if sess.steering is None:
         return
     from scenery_insitu_tpu.runtime.streaming import apply_steering
@@ -52,8 +58,8 @@ def drain_steering(sess) -> None:
         for msg in sess.steering.drain():
             sess.camera, other = apply_steering(sess.camera, msg)
             for kind_msg in other.values():
-                for cb in sess.on_steer:
-                    cb(kind_msg)
+                sess._sink_guard.run(sess.on_steer, kind_msg,
+                                     kind="on_steer callback")
 
 
 def apply_tf_steering(sess, msg: dict, invalidate) -> None:
@@ -267,6 +273,12 @@ class InSituSession:
         self.camera = camera or Camera.create(
             (0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.3, far=20.0)
         self.sinks: List[Sink] = list(sinks)
+        # session failure isolation (docs/ROBUSTNESS.md): every frame
+        # sink, tile sink and on_steer callback runs behind this guard —
+        # one failing fault.max_sink_failures consecutive times is
+        # quarantined (session.sink ledger) instead of killing the run
+        self._sink_guard = SinkGuard(self.cfg.fault.max_sink_failures,
+                                     log=self.log)
         # tile-granular delivery (docs/PERF.md "Tile waves"): with
         # composite.schedule == "waves" every VDI frame is also split
         # into its n_ranks * wave_tiles column-block tiles and each tile
@@ -514,8 +526,7 @@ class InSituSession:
             payload["frame"] = index
             payload["meta"] = meta
         with self.obs.span("sinks", frame=index):
-            for s in self.sinks:
-                s(index, payload)
+            self._sink_guard.run(self.sinks, index, payload)
         return payload
 
     def _deliver_tiles(self, index: int, out, meta=None,
@@ -547,8 +558,8 @@ class InSituSession:
                     "col0": t * wb, "meta": meta,
                 }
                 self.obs.count("tiles_delivered")
-                for s in self.tile_sinks:
-                    s(index, payload)
+                self._sink_guard.run(self.tile_sinks, index, payload,
+                                     kind="tile sink")
 
     # ------------------------------------------------ render rebalancing
 
@@ -827,8 +838,8 @@ class InSituSession:
                                    "vdi_depth": depth[i],
                                    "frame": idx, "meta": meta}
                         with self.obs.span("sinks", frame=idx):
-                            for s in self.sinks:
-                                s(idx, payload)
+                            self._sink_guard.run(self.sinks, idx,
+                                                 payload)
                         self.timers.frame_done()
                 else:
                     for _ in range(block):

@@ -19,20 +19,152 @@
 Everything degrades gracefully: constructing any endpoint raises
 ImportError only when pyzmq is genuinely missing, and the session works
 fully without streaming attached.
+
+Self-healing delivery plane (docs/ROBUSTNESS.md): every frame/tile
+message carries a publisher **epoch**, a monotone u32 **sequence
+number** and a **CRC32 per blob**, so the subscriber validates wire
+bytes BEFORE decode and drops corrupt/truncated messages as typed
+``StreamDrop`` records instead of raising; sequence gaps, duplicates
+and publisher restarts are detected and ledgered (``stream.gap`` /
+``stream.integrity``). Publishers emit heartbeats when idle
+(``maybe_heartbeat``), subscribers track last-seen time and reconnect
+past ``fault.liveness_timeout_s`` with bounded exponential backoff
+(utils/retry.py), and ``FrameAssembler`` turns tile streams back into
+frames, abandoning incomplete frames once ``fault.assembler_window``
+newer ones have started.
 """
 
 from __future__ import annotations
 
+import itertools
+import os
+import threading
 import time
-from typing import Callable, Iterator, Optional, Tuple
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional, Tuple, Union
 
 import numpy as np
 
+from scenery_insitu_tpu import obs as _obs
+from scenery_insitu_tpu.config import FaultConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
 from scenery_insitu_tpu.io.vdi_io import compress, decompress
+from scenery_insitu_tpu.utils.retry import Backoff
 
 _META_FIELDS = VDIMetadata._fields
+
+# ------------------------------------------------- sequence-space helpers
+
+SEQ_MASK = 0xFFFFFFFF
+_EPOCH_COUNT = itertools.count(1)
+
+
+def _make_epoch() -> int:
+    """Publisher-incarnation id: distinguishes a restarted publisher
+    (sequence counter reset) from a sequence gap on a live one. Random
+    32-bit (collision odds ~2^-32 per restart — a pid/counter scheme
+    collides at 2^-16, which over long deployments silently blackholes
+    the successor's stream as 'stale'); xor'd with a process counter so
+    even an exhausted entropy pool cannot hand two publishers in one
+    process the same epoch. Tests pass ``epoch=`` explicitly for
+    determinism."""
+    r = int.from_bytes(os.urandom(4), "little")
+    return ((r ^ next(_EPOCH_COUNT)) & SEQ_MASK) or 1
+
+
+def seq_delta(a: int, b: int, bits: int = 32) -> int:
+    """Wrap-aware ``a - b`` in modular sequence space, mapped into
+    ``[-2**(bits-1), 2**(bits-1))`` — positive means ``a`` is newer.
+    Shared by the VDI stream continuity check and the UDP video
+    receiver's eviction (a u32 frame counter wraps after ~2.3 years at
+    60 FPS, and an unwrapped ``f < fid - 4`` comparison would leak and
+    misorder across the wrap)."""
+    mask = (1 << bits) - 1
+    half = 1 << (bits - 1)
+    d = (a - b) & mask
+    return d - (1 << bits) if d >= half else d
+
+
+@dataclass(frozen=True)
+class StreamDrop:
+    """Typed record of one message the subscriber refused: ``kind`` is
+    ``"integrity"`` (failed checksum/size/shape validation before
+    decode), ``"stale"`` (duplicate or reordered sequence number) or
+    ``"malformed"`` (header unparseable). Returned instead of raising —
+    the stream outlives any single bad message."""
+
+    kind: str
+    reason: str
+    epoch: Optional[int] = None
+    seq: Optional[int] = None
+
+
+_HEARTBEAT = object()        # receive-loop sentinel: liveness, not a frame
+
+
+class _HeartbeatPacer:
+    """Shared idle-heartbeat pacing: subclasses define ``heartbeat()``
+    and keep ``_last_send`` fresh; ``maybe_heartbeat()`` fires one only
+    after ``fault.heartbeat_period_s`` of silence."""
+
+    def maybe_heartbeat(self) -> bool:
+        """Heartbeat only if nothing was sent for
+        ``fault.heartbeat_period_s``; returns True when one went out.
+        Cheap to call every loop iteration."""
+        if (time.monotonic() - self._last_send
+                < self.fault.heartbeat_period_s):
+            return False
+        self.heartbeat()
+        return True
+
+
+class _ReconnectSupervisor:
+    """Shared liveness supervision (docs/ROBUSTNESS.md): track last-seen
+    traffic and, past ``fault.liveness_timeout_s``, re-establish the
+    socket via the subclass's ``_reopen()``, pacing retries on the
+    bounded backoff ladder. Supervision is OPT-IN (``fault=`` passed to
+    the constructor): idle publishers are normal, and without a
+    heartbeat pump a healthy-but-slow stream must not be torn down.
+    A failed re-open (e.g. transient EADDRINUSE right after close) is
+    ledgered and retried on the next backoff tick, never raised into
+    the render loop."""
+
+    _what = "stream"             # names the stream in the ledger reason
+
+    def _init_supervision(self, supervised: bool) -> None:
+        self._supervised = supervised
+        self._backoff = Backoff(self.fault.backoff_base_s,
+                                self.fault.backoff_cap_s)
+        self._last_seen = time.monotonic()
+        self._next_reconnect = 0.0
+
+    def _supervise(self) -> None:
+        t = self.fault.liveness_timeout_s
+        if not self._supervised or t <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_seen <= t:
+            self._backoff.reset()
+            return
+        if now < self._next_reconnect:
+            return
+        self._next_reconnect = now + self._backoff.next_delay()
+        try:
+            self._reopen()
+        except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (mints below)
+            _obs.degrade(
+                "stream.liveness", "reconnecting", "reconnect failed",
+                f"socket re-open failed ({type(e).__name__}); retrying "
+                "on the backoff ladder", warn=False)
+            return
+        self.stats["reconnects"] += 1
+        _obs.get_recorder().count("stream_reconnects")
+        _obs.degrade(
+            "stream.liveness", "connected", "reconnecting",
+            f"no {self._what} traffic past liveness_timeout_s={t}; "
+            "re-dialing with bounded backoff", warn=False)
 
 
 def _msgpack():
@@ -47,7 +179,7 @@ def _zmq():
 
 # --------------------------------------------------------------- VDI stream
 
-class VDIPublisher:
+class VDIPublisher(_HeartbeatPacer):
     """PUB endpoint streaming (metadata, color, depth) per frame.
 
     ``precision="qpack8"`` runs the sort-last wire quantizer
@@ -59,7 +191,9 @@ class VDIPublisher:
     headers agree on what the bytes are. Lossy by the wire contract."""
 
     def __init__(self, bind: str = "tcp://*:6655", codec: str = "zstd",
-                 level: int = -1, precision: str = "f32"):
+                 level: int = -1, precision: str = "f32",
+                 fault: Optional[FaultConfig] = None,
+                 epoch: Optional[int] = None):
         from scenery_insitu_tpu.io.vdi_io import resolve_codec
 
         if precision not in ("f32", "qpack8"):
@@ -72,6 +206,20 @@ class VDIPublisher:
         self.codec = resolve_codec(codec)
         self.level = level
         self.precision = precision
+        self.fault = fault or FaultConfig()
+        # stream continuity identity (docs/ROBUSTNESS.md): the epoch
+        # names this publisher incarnation, seq counts every message
+        # (frames, tiles AND heartbeats share one counter, so idle
+        # heartbeats keep the continuity check alive)
+        self.epoch = _make_epoch() if epoch is None else int(epoch)
+        self.seq = 0
+        self.last_bytes = {}       # header/color/depth sizes of last send
+        self._last_send = time.monotonic()
+        # serializes frame publishes with the optional background
+        # heartbeat pump (zmq sockets are not thread-safe)
+        self._send_lock = threading.Lock()
+        self._hb_stop = None
+        self._hb_thread = None
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.PUB)
         if bind.endswith(":0"):                      # ephemeral port for tests
@@ -80,6 +228,41 @@ class VDIPublisher:
         else:
             self.sock.bind(bind)
             self.endpoint = bind.replace("*", "127.0.0.1")
+
+    def _next_seq(self) -> int:
+        self.seq = (self.seq + 1) & SEQ_MASK
+        return self.seq
+
+    def heartbeat(self) -> None:
+        """Send one idle heartbeat (single-part message carrying only
+        the continuity header) — subscribers refresh their last-seen
+        time and sequence tracking without receiving a frame."""
+        with self._send_lock:
+            self.sock.send(_msgpack().packb(
+                {"hb": 1, "epoch": self.epoch, "seq": self._next_seq()}))
+            self._last_send = time.monotonic()
+
+    def start_heartbeats(self) -> None:
+        """Opt-in background heartbeat pump (docs/ROBUSTNESS.md): a
+        daemon thread fires ``maybe_heartbeat`` so supervised
+        subscribers can tell a slow frame from a dead publisher even
+        when the render loop is stalled inside a dispatch. Sends are
+        lock-serialized with the frame publishes; ``close()`` stops the
+        thread. Pair with ``VDISubscriber(fault=...)``."""
+        if self._hb_thread is not None:
+            return
+        self._hb_stop = threading.Event()
+
+        def pump():
+            # wake at half the period so an idle gap is detected within
+            # ~1.5 periods worst case
+            while not self._hb_stop.wait(
+                    self.fault.heartbeat_period_s / 2):
+                self.maybe_heartbeat()
+
+        self._hb_thread = threading.Thread(
+            target=pump, daemon=True, name="vdi-publisher-heartbeat")
+        self._hb_thread.start()
 
     def publish(self, vdi: VDI, meta: VDIMetadata) -> int:
         """Send one frame; returns wire bytes (≅ the compressed publish loop,
@@ -127,83 +310,340 @@ class VDIPublisher:
                              self.codec, self.level)
             dblob = compress(np.ascontiguousarray(depth).tobytes(),
                              self.codec, self.level)
-            header = _msgpack().packb({
+            fields = {
                 "codec": self.codec,
                 "precision": self.precision,
                 "qscale": qscale,
                 "tile": tile,
+                # integrity + continuity (docs/ROBUSTNESS.md): CRCs are
+                # of the WIRE blobs, so truncation/corruption is caught
+                # before any decompress/reshape runs on the subscriber
+                "epoch": self.epoch,
+                "crc": [zlib.crc32(cblob), zlib.crc32(dblob)],
                 "color_shape": list(color.shape),
                 "depth_shape": list(depth.shape),
                 "meta": {f: np.asarray(getattr(meta, f)).tolist()
                          for f in _META_FIELDS},
-            })
-        self.sock.send_multipart([header, cblob, dblob])
+            }
+        with self._send_lock:
+            # seq is minted INSIDE the lock: a background heartbeat
+            # claiming a later seq but reaching the wire first would
+            # make this frame read as stale at the subscriber
+            header = _msgpack().packb({**fields,
+                                       "seq": self._next_seq()})
+            self.sock.send_multipart([header, cblob, dblob])
+            self._last_send = time.monotonic()
+        self.last_bytes = {"header": len(header), "color": len(cblob),
+                           "depth": len(dblob)}
         return len(header) + len(cblob) + len(dblob)
 
     def close(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
         self.sock.close(linger=0)
 
 
-class VDISubscriber:
+class VDISubscriber(_ReconnectSupervisor):
     """SUB endpoint for the streamed-VDI client (novel-view rendering of
-    received VDIs via ops.vdi_render)."""
+    received VDIs via ops.vdi_render).
 
-    def __init__(self, connect: str = "tcp://localhost:6655"):
+    Hardened against the wire (docs/ROBUSTNESS.md): every message is
+    validated BEFORE decode — part count, header parse, per-blob CRC32,
+    then decompressed byte counts against the declared shapes × itemsize
+    — and a failing message comes back as a typed ``StreamDrop`` (never
+    an exception). Sequence continuity (gaps, duplicates, publisher
+    restarts) is tracked per epoch and ledgered (``stream.gap``);
+    ``self.stats`` counts frames/drops/gaps/heartbeats/reconnects.
+
+    Liveness supervision is OPT-IN: construct with ``fault=`` and the
+    subscriber reconnects with bounded exponential backoff
+    (``stream.liveness``) after ``liveness_timeout_s`` of silence —
+    pair it with a publisher that pumps ``maybe_heartbeat()``, or a
+    healthy-but-slow stream would be torn down mid-frame."""
+
+    def __init__(self, connect: str = "tcp://localhost:6655",
+                 fault: Optional[FaultConfig] = None):
+        self.connect = connect
+        self.fault = fault or FaultConfig()
+        self.last_epoch: Optional[int] = None
+        self.last_seq: Optional[int] = None
+        self.stats = {"frames": 0, "drops": 0, "gaps": 0, "stale": 0,
+                      "heartbeats": 0, "epoch_changes": 0, "reconnects": 0}
+        self._init_supervision(supervised=fault is not None)
+        self._open()
+
+    def _open(self) -> None:
         zmq = _zmq()
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.SUB)
         self.sock.setsockopt(zmq.SUBSCRIBE, b"")
-        self.sock.connect(connect)
+        self.sock.connect(self.connect)
+
+    def _reopen(self) -> None:
+        """A PUB/SUB reconnect is idempotent — worst case it
+        re-subscribes to a healthy stream."""
+        self.sock.close(linger=0)
+        self._open()
 
     def receive(self, timeout_ms: Optional[int] = None
-                ) -> Optional[Tuple[VDI, VDIMetadata]]:
+                ) -> Union[None, StreamDrop, Tuple[VDI, VDIMetadata]]:
         got = self.receive_tile(timeout_ms)
-        return None if got is None else got[:2]
+        if got is None or isinstance(got, StreamDrop):
+            return got
+        return got[:2]
 
     def receive_tile(self, timeout_ms: Optional[int] = None
-                     ) -> Optional[Tuple[VDI, VDIMetadata,
-                                         Optional[dict]]]:
+                     ) -> Union[None, StreamDrop,
+                                Tuple[VDI, VDIMetadata, Optional[dict]]]:
         """Like `receive`, but also returns the tile placement header
         ({tile, tiles, col0}) of a `VDIPublisher.publish_tile` message —
         None for whole-frame messages. Tiles of frame f arrive in
         column order before frame f closes, so a viewer can assemble
-        incrementally: allocate on the first tile (tiles * width
-        columns), paste each tile at its col0."""
-        if timeout_ms is not None:
-            if not self.sock.poll(timeout_ms):
-                return None
-        header, cblob, dblob = self.sock.recv_multipart()
-        h = _msgpack().unpackb(header)
-        precision = h.get("precision", "f32")
-        if precision == "qpack8":
-            # the publisher's pre-codec quantize pass (header carries the
-            # [near, far] scale): dequantize back to the f32 convention
-            from scenery_insitu_tpu.ops.wire import qpack8_dequantize_np
+        incrementally (see `FrameAssembler`).
 
-            qc = np.frombuffer(decompress(cblob, h["codec"]), np.uint32) \
-                .reshape(h["color_shape"])
-            qd = np.frombuffer(decompress(dblob, h["codec"]), np.uint16) \
-                .reshape(h["depth_shape"])
-            near, far = h["qscale"]
-            color, depth = qpack8_dequantize_np(qc, qd, near, far)
-        else:
-            color = np.frombuffer(decompress(cblob, h["codec"]), np.float32) \
-                .reshape(h["color_shape"])
-            depth = np.frombuffer(decompress(dblob, h["codec"]), np.float32) \
-                .reshape(h["depth_shape"])
-        m = h["meta"]
-        meta = VDIMetadata.create(
-            projection=np.asarray(m["projection"], np.float32),
-            view=np.asarray(m["view"], np.float32),
-            model=np.asarray(m["model"], np.float32),
-            volume_dims=np.asarray(m["volume_dims"], np.float32),
-            window_dims=np.asarray(m["window_dims"], np.int32),
-            nw=float(np.asarray(m["nw"])), index=int(np.asarray(m["index"])),
-            precision=int(np.asarray(m.get("precision", 0))))
+        Returns None on timeout, a `StreamDrop` for a message that
+        failed validation, or the decoded (VDI, meta, tile) tuple.
+        Heartbeats are consumed internally (they refresh liveness and
+        sequence tracking) and never surface."""
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + timeout_ms / 1000.0)
+        while True:
+            self._supervise()
+            if deadline is not None:
+                wait = max(0.0, deadline - time.monotonic())
+                if not self.sock.poll(int(wait * 1000)):
+                    return None
+            elif not self.sock.poll(1000):
+                continue          # blocking mode: re-check liveness 1/s
+            parts = self.sock.recv_multipart()
+            got = self._decode(parts)
+            if got is _HEARTBEAT:
+                if deadline is not None and time.monotonic() >= deadline:
+                    return None
+                continue
+            return got
+
+    # ------------------------------------------------------- validation
+    def _drop(self, kind: str, reason: str, epoch=None,
+              seq=None) -> StreamDrop:
+        self.stats["drops"] += 1
+        if kind == "stale":
+            self.stats["stale"] += 1
+        _obs.get_recorder().count("stream_drops")
+        _obs.degrade(
+            "stream.integrity" if kind != "stale" else "stream.gap",
+            "stream message", "dropped before decode",
+            ("duplicate or reordered message" if kind == "stale"
+             else "failed integrity validation (checksum/size/shape/"
+                  "header)"), warn=False)
+        return StreamDrop(kind, reason, epoch, seq)
+
+    def _track_continuity(self, h: dict) -> Optional[StreamDrop]:
+        """Update epoch/seq tracking from one parsed header; returns a
+        StreamDrop for stale (duplicate/reordered) messages, else None.
+        Messages from pre-continuity publishers (no epoch/seq) pass."""
+        epoch, seq = h.get("epoch"), h.get("seq")
+        if epoch is None or seq is None:
+            return None
+        if self.last_epoch is not None and epoch != self.last_epoch:
+            self.stats["epoch_changes"] += 1
+            _obs.degrade("stream.gap", f"epoch {self.last_epoch}",
+                         f"epoch {epoch}",
+                         "publisher restarted (epoch changed); sequence "
+                         "tracking reset", warn=False)
+            self.last_seq = None
+        self.last_epoch = epoch
+        if self.last_seq is not None:
+            d = seq_delta(seq, self.last_seq)
+            if d <= 0:
+                return self._drop("stale",
+                                  f"seq {seq} after {self.last_seq}",
+                                  epoch, seq)
+            if d > 1:
+                self.stats["gaps"] += d - 1
+                _obs.get_recorder().count("stream_gap_messages", d - 1)
+                _obs.degrade("stream.gap", "contiguous sequence",
+                             f"{d - 1} message(s) missing",
+                             "sequence gap detected on the VDI stream",
+                             warn=False)
+        self.last_seq = seq
+        return None
+
+    def _decode(self, parts):
+        """Validate one multipart message and decode it, or explain why
+        not. Order matters: cheap checks (part count, header parse,
+        CRC of the wire blobs) run before any decompress/reshape."""
+        self._last_seen = time.monotonic()
+        self._backoff.reset()
+        msgpack = _msgpack()
+        if len(parts) == 1:
+            try:
+                h = msgpack.unpackb(parts[0])
+            except Exception:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
+                return self._drop("malformed", "unparseable single-part "
+                                               "message")
+            if isinstance(h, dict) and h.get("hb"):
+                self.stats["heartbeats"] += 1
+                # a stale/duplicated heartbeat is counted by the
+                # continuity tracker but carries no frame — heartbeats
+                # NEVER surface to the caller
+                self._track_continuity(h)
+                return _HEARTBEAT
+            return self._drop("integrity", "single-part message is not "
+                                           "a heartbeat")
+        if len(parts) != 3:
+            return self._drop("integrity",
+                              f"expected 3 parts, got {len(parts)} "
+                              "(truncated multipart)")
+        header, cblob, dblob = parts
+        try:
+            h = msgpack.unpackb(header)
+            if not isinstance(h, dict):
+                raise TypeError("header is not a map")
+            cshape = tuple(int(x) for x in h["color_shape"])
+            dshape = tuple(int(x) for x in h["depth_shape"])
+            codec = h["codec"]
+        except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
+            return self._drop("malformed", f"bad header: {e!r}")
+        epoch, seq = h.get("epoch"), h.get("seq")
+        # continuity first, ONCE: a message that is both stale and
+        # corrupt is one refusal, not two ledger rows. A corrupt blob
+        # still advances seq tracking — the header parsed, so the
+        # message was received-and-refused, not missing (no spurious
+        # gap on its successor).
+        stale = self._track_continuity(h)
+        if stale is not None:
+            return stale
+        crc = h.get("crc")
+        if crc is not None and list(crc) != [zlib.crc32(cblob),
+                                             zlib.crc32(dblob)]:
+            return self._drop("integrity", "blob checksum mismatch",
+                              epoch, seq)
+        precision = h.get("precision", "f32")
+        cdt, ddt = ((np.uint32, np.uint16) if precision == "qpack8"
+                    else (np.float32, np.float32))
+        try:
+            craw = decompress(cblob, codec)
+            draw = decompress(dblob, codec)
+        except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
+            return self._drop("integrity", f"decompress failed: {e!r}",
+                              epoch, seq)
+        want_c = int(np.prod(cshape)) * np.dtype(cdt).itemsize
+        want_d = int(np.prod(dshape)) * np.dtype(ddt).itemsize
+        if len(craw) != want_c or len(draw) != want_d:
+            # a truncated/corrupt blob must be rejected HERE — handing
+            # it to frombuffer/reshape is the pre-PR crash
+            return self._drop(
+                "integrity",
+                f"blob bytes ({len(craw)}, {len(draw)}) != declared "
+                f"shapes ({want_c}, {want_d})", epoch, seq)
+        try:
+            if precision == "qpack8":
+                # the publisher's pre-codec quantize pass (header
+                # carries the [near, far] scale): dequantize back to f32
+                from scenery_insitu_tpu.ops.wire import (
+                    qpack8_dequantize_np)
+
+                qc = np.frombuffer(craw, np.uint32).reshape(cshape)
+                qd = np.frombuffer(draw, np.uint16).reshape(dshape)
+                near, far = h["qscale"]
+                color, depth = qpack8_dequantize_np(qc, qd, near, far)
+            else:
+                color = np.frombuffer(craw, np.float32).reshape(cshape)
+                depth = np.frombuffer(draw, np.float32).reshape(dshape)
+            m = h["meta"]
+            meta = VDIMetadata.create(
+                projection=np.asarray(m["projection"], np.float32),
+                view=np.asarray(m["view"], np.float32),
+                model=np.asarray(m["model"], np.float32),
+                volume_dims=np.asarray(m["volume_dims"], np.float32),
+                window_dims=np.asarray(m["window_dims"], np.int32),
+                nw=float(np.asarray(m["nw"])),
+                index=int(np.asarray(m["index"])),
+                precision=int(np.asarray(m.get("precision", 0))))
+        except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
+            return self._drop("integrity", f"decode failed: {e!r}",
+                              epoch, seq)
+        self.stats["frames"] += 1
         return VDI(color, depth), meta, h.get("tile")
 
     def close(self) -> None:
         self.sock.close(linger=0)
+
+
+class FrameAssembler:
+    """Assemble `publish_tile` streams back into whole frames — the
+    ``VideoReceiver._parts`` eviction pattern, generalized to the VDI
+    tile stream (docs/ROBUSTNESS.md "Degraded frames").
+
+    Feed it every successful `receive_tile` result; whole-frame messages
+    pass straight through, tile messages accumulate per frame index and
+    the frame is returned once all tiles arrived (pasted in col0 order).
+    An incomplete frame is ABANDONED — ledgered ``stream.gap``, counted
+    in ``stats["abandoned"]`` — once ``window`` newer frames have
+    started, so one lost tile costs one frame, not unbounded memory."""
+
+    def __init__(self, window: Optional[int] = None,
+                 fault: Optional[FaultConfig] = None):
+        if window is None:
+            # the config-threaded default: FrameworkConfig.fault
+            # (pass a session's cfg.fault here so the knob is live)
+            window = (fault or FaultConfig()).assembler_window
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = window
+        self._frames = {}   # frame index -> {tiles: {t: (col0, vdi)},
+                            #                 total, meta}
+        self._newest = None  # newest frame index ever seen
+        self.stats = {"assembled": 0, "abandoned": 0, "tiles": 0,
+                      "passthrough": 0, "late_tiles": 0}
+
+    def add(self, vdi: VDI, meta: VDIMetadata, tile: Optional[dict]
+            ) -> Optional[Tuple[VDI, VDIMetadata]]:
+        """Returns the completed (VDI, meta) when this message closed a
+        frame (or was a whole-frame message), else None."""
+        if tile is None:
+            self.stats["passthrough"] += 1
+            return vdi, meta
+        idx = int(np.asarray(meta.index))
+        if self._newest is not None and idx < self._newest - self.window:
+            # straggler tile of a frame already past the eviction
+            # horizon (assembled or abandoned) — re-creating its entry
+            # would re-abandon it once per late tile
+            self.stats["late_tiles"] += 1
+            return None
+        self._newest = (idx if self._newest is None
+                        else max(self._newest, idx))
+        entry = self._frames.setdefault(
+            idx, {"tiles": {}, "total": int(tile["tiles"]), "meta": meta})
+        entry["tiles"][int(tile["tile"])] = (int(tile["col0"]), vdi)
+        self.stats["tiles"] += 1
+        self._evict(newest=self._newest)
+        if idx not in self._frames \
+                or len(entry["tiles"]) < entry["total"]:
+            return None
+        del self._frames[idx]
+        placed = sorted(entry["tiles"].values(), key=lambda cv: cv[0])
+        color = np.concatenate([np.asarray(v.color) for _, v in placed],
+                               axis=-1)
+        depth = np.concatenate([np.asarray(v.depth) for _, v in placed],
+                               axis=-1)
+        self.stats["assembled"] += 1
+        return VDI(color, depth), entry["meta"]
+
+    def _evict(self, newest: int) -> None:
+        for old in [f for f in self._frames if f < newest - self.window]:
+            del self._frames[old]
+            self.stats["abandoned"] += 1
+            _obs.get_recorder().count("frames_abandoned")
+            _obs.degrade(
+                "stream.gap", "complete tile frame",
+                "frame abandoned incomplete",
+                f"tile loss: a frame was still incomplete after "
+                f"{self.window} newer frames started", warn=False)
 
 
 # ----------------------------------------------------------------- steering
@@ -259,48 +699,122 @@ def apply_steering(cam: Camera, msg: dict) -> Tuple[Camera, dict]:
     return cam, {kind: msg}
 
 
-class SteeringEndpoint:
-    """Renderer-side SUB socket draining steering messages each frame."""
+class SteeringEndpoint(_ReconnectSupervisor):
+    """Renderer-side SUB socket draining steering messages each frame.
 
-    def __init__(self, connect_or_bind: str = "tcp://*:6656", bind: bool = True):
+    The socket is network-facing: one malformed or oversized message
+    must not kill an in-situ run mid-simulation. ``drain`` therefore
+    validates per message — size cap first (before unpack), then msgpack
+    parse, then "is it a dict" — drops failures on the
+    ``stream.steering`` ledger and KEEPS draining. Heartbeats
+    (``{"hb": 1}``) refresh liveness and are consumed; past
+    ``fault.liveness_timeout_s`` with no traffic the endpoint re-opens
+    its socket with bounded backoff (liveness is opt-in here: steering
+    is bursty, so the default FaultConfig applies only when ``fault`` is
+    passed — pass one to enable supervision)."""
+
+    def __init__(self, connect_or_bind: str = "tcp://*:6656",
+                 bind: bool = True, fault: Optional[FaultConfig] = None):
+        # None = liveness supervision off (idle viewers are normal);
+        # the size cap still applies with the default FaultConfig
+        self.fault = fault or FaultConfig()
+        self.bind = bind
+        self.stats = {"messages": 0, "dropped": 0, "heartbeats": 0,
+                      "reconnects": 0}
+        self._init_supervision(supervised=fault is not None)
         zmq = _zmq()
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.SUB)
         self.sock.setsockopt(zmq.SUBSCRIBE, b"")
         if bind and connect_or_bind.endswith(":0"):
             port = self.sock.bind_to_random_port(connect_or_bind[:-2])
+            # the REAL re-bindable address keeps the wildcard host; the
+            # display/connect endpoint rewrites it for local viewers
+            self._addr = f"{connect_or_bind[:-2]}:{port}"
             self.endpoint = (f"{connect_or_bind[:-2].replace('*', '127.0.0.1')}"
                              f":{port}")
         elif bind:
             self.sock.bind(connect_or_bind)
+            self._addr = connect_or_bind
             self.endpoint = connect_or_bind.replace("*", "127.0.0.1")
         else:
             self.sock.connect(connect_or_bind)
+            self._addr = connect_or_bind
             self.endpoint = connect_or_bind
+
+    def _reopen(self) -> None:
+        """Tear down and re-establish the socket on the ORIGINAL address
+        (a '*' bind must stay a wildcard bind — rewriting it to the
+        loopback display form would cut off every remote viewer)."""
+        zmq = _zmq()
+        self.sock.close(linger=0)
+        self.sock = self.ctx.socket(zmq.SUB)
+        self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        if self.bind:
+            self.sock.bind(self._addr)
+        else:
+            self.sock.connect(self._addr)
+
+    _what = "steering"
+
+    def _drop_steering(self, why: str) -> None:
+        self.stats["dropped"] += 1
+        _obs.get_recorder().count("steering_drops")
+        _obs.degrade("stream.steering", "steering message", "dropped",
+                     why, warn=False)
 
     def drain(self) -> Iterator[dict]:
         zmq = _zmq()
+        self._supervise()
         while True:
             try:
-                yield _msgpack().unpackb(self.sock.recv(zmq.NOBLOCK))
+                raw = self.sock.recv(zmq.NOBLOCK)
             except zmq.Again:
                 return
+            self._last_seen = time.monotonic()
+            if len(raw) > self.fault.max_message_bytes:
+                self._drop_steering(
+                    "message exceeds fault.max_message_bytes")
+                continue
+            try:
+                msg = _msgpack().unpackb(raw)
+            except Exception:
+                self._drop_steering("unparseable msgpack from the "
+                                    "network-facing socket")
+                continue
+            if not isinstance(msg, dict):
+                self._drop_steering("steering payload is not a map")
+                continue
+            if msg.get("hb"):
+                self.stats["heartbeats"] += 1
+                continue
+            self.stats["messages"] += 1
+            yield msg
 
     def close(self) -> None:
         self.sock.close(linger=0)
 
 
-class SteeringPublisher:
+class SteeringPublisher(_HeartbeatPacer):
     """Viewer-side PUB socket (≅ the ZMQ publisher feeding InSituMaster)."""
 
-    def __init__(self, connect: str):
+    def __init__(self, connect: str,
+                 fault: Optional[FaultConfig] = None):
         zmq = _zmq()
+        self.fault = fault or FaultConfig()
+        self._last_send = time.monotonic()
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.PUB)
         self.sock.connect(connect)
 
     def send(self, msg: dict) -> None:
         self.sock.send(_msgpack().packb(msg))
+        self._last_send = time.monotonic()
+
+    def heartbeat(self) -> None:
+        """Idle keepalive so a supervised SteeringEndpoint can tell a
+        quiet viewer from a dead one."""
+        self.send({"hb": 1})
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -433,7 +947,9 @@ class VideoStreamer:
             head = struct.pack("!4sIHH", self.MAGIC,
                                self.frame_id & 0xFFFFFFFF, p, nparts)
             sent += self.sock.sendto(head + payload, self.addr)
-        self.frame_id += 1
+        # wrap in lockstep with the u32 wire field — the receiver's
+        # eviction compares in wrap-aware sequence space (seq_delta)
+        self.frame_id = (self.frame_id + 1) & SEQ_MASK
         return sent
 
     def close(self) -> None:
@@ -474,8 +990,12 @@ class VideoReceiver:
                 continue                                   # corrupt/foreign
             parts = self._parts.setdefault(fid, {})
             parts[part] = pkt[12:]
-            # evict incomplete older frames (lost datagrams must not leak)
-            for old in [f for f in self._parts if f < fid - 4]:
+            # evict incomplete older frames (lost datagrams must not
+            # leak) — wrap-aware: the u32 frame id wraps on long
+            # streams, and an unwrapped `f < fid - 4` would both leak
+            # the pre-wrap entries forever and mis-evict post-wrap ones
+            for old in [f for f in self._parts
+                        if seq_delta(fid, f) > 4]:
                 del self._parts[old]
             if all(p in parts for p in range(nparts)):
                 blob = b"".join(parts[p] for p in range(nparts))

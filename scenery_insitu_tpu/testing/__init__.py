@@ -1,0 +1,3 @@
+"""Test-support subpackage: seeded fault injection for the delivery
+plane (``testing.faults``; docs/ROBUSTNESS.md). Importable from
+production code for chaos drills but never imported BY it."""

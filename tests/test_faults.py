@@ -1,0 +1,662 @@
+"""Delivery-plane chaos matrix + integrity/liveness/quarantine units
+(docs/ROBUSTNESS.md; ISSUE 11).
+
+Every scenario injects seeded, deterministic faults at a failure-domain
+seam (testing/faults.py) and asserts the three-part contract: the
+endpoint/session stays ALIVE, the expected ``obs.degrade`` component is
+minted, and no exception escapes. The clean-path control asserts parity:
+with no faults, the f32 stream decodes bit-identically and the header
+stays under 1% of frame bytes."""
+
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("zmq")
+pytest.importorskip("msgpack")
+
+from scenery_insitu_tpu import obs
+from scenery_insitu_tpu.config import FaultConfig, FrameworkConfig
+from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
+from scenery_insitu_tpu.runtime.streaming import (FrameAssembler,
+                                                  SteeringEndpoint,
+                                                  SteeringPublisher,
+                                                  StreamDrop,
+                                                  VDIPublisher,
+                                                  VDISubscriber,
+                                                  seq_delta)
+from scenery_insitu_tpu.testing.faults import (ChaosSocket, FaultSpec,
+                                               SilentRank, inject,
+                                               run_matrix)
+
+K, H, W = 4, 12, 16
+
+
+def _vdi_meta(index=0):
+    rng = np.random.default_rng(0)
+    color = rng.random((K, 4, H, W)).astype(np.float32)
+    depth = rng.random((K, 2, H, W)).astype(np.float32)
+    meta = VDIMetadata.create(np.eye(4), np.eye(4), volume_dims=(8, 8, 8),
+                              window_dims=(W, H), nw=0.1, index=index)
+    return VDI(color, depth), meta
+
+
+def _pair(**sub_kw):
+    pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib")
+    sub = VDISubscriber(pub.endpoint, **sub_kw)
+    time.sleep(0.2)                        # PUB/SUB join settles
+    return pub, sub
+
+
+def _drain(sub, timeout_s=5.0):
+    received, drops = [], []
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = sub.receive_tile(timeout_ms=100)
+        if got is None:
+            break
+        (drops if isinstance(got, StreamDrop) else received).append(got)
+    return received, drops
+
+
+# ------------------------------------------------------------- integrity
+
+def test_corrupt_blob_drops_not_raises():
+    """A corrupt blob fails the CRC before decode: typed StreamDrop,
+    stream.integrity ledger, subscriber still decodes clean frames."""
+    pub, sub = _pair()
+    try:
+        vdi, meta = _vdi_meta()
+        inject(pub, FaultSpec(corrupt=1.0), seed=3)
+        for i in range(3):
+            pub.publish(vdi, meta._replace(index=np.int32(i)))
+        received, drops = _drain(sub)
+        assert received == []
+        assert len(drops) == 3
+        assert all(d.kind == "integrity" for d in drops)
+        assert any(e["component"] == "stream.integrity"
+                   for e in obs.ledger())
+        # the stream outlives the bad bytes: unwrap and publish clean
+        pub.sock = pub.sock.sock
+        pub.publish(vdi, meta._replace(index=np.int32(9)))
+        got = sub.receive(timeout_ms=3000)
+        assert got is not None and not isinstance(got, StreamDrop)
+        np.testing.assert_array_equal(np.asarray(vdi.color), got[0].color)
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_truncated_multipart_dropped():
+    pub, sub = _pair()
+    try:
+        vdi, meta = _vdi_meta()
+        inject(pub, FaultSpec(truncate=1.0), seed=0)
+        pub.publish(vdi, meta)
+        received, drops = _drain(sub, timeout_s=2.0)
+        assert received == [] and len(drops) == 1
+        assert drops[0].kind == "integrity"
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_lying_header_shape_dropped_before_reshape():
+    """Satellite: a header declaring shapes the blob bytes cannot fill
+    must be rejected by the byte-count check, not crash frombuffer/
+    reshape (the pre-PR failure mode)."""
+    import zlib as _zlib
+
+    import msgpack
+
+    pub, sub = _pair()
+    try:
+        cblob = _zlib.compress(b"\x00" * 64)   # far too small
+        dblob = _zlib.compress(b"\x00" * 64)
+        header = msgpack.packb({
+            "codec": "zlib", "precision": "f32", "qscale": None,
+            "tile": None, "epoch": 1, "seq": 1,
+            "crc": [_zlib.crc32(cblob), _zlib.crc32(dblob)],
+            "color_shape": [K, 4, H, W], "depth_shape": [K, 2, H, W],
+            "meta": {}})
+        pub.sock.send_multipart([header, cblob, dblob])
+        got = sub.receive_tile(timeout_ms=3000)
+        assert isinstance(got, StreamDrop) and got.kind == "integrity"
+        assert "declared" in got.reason
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_gap_and_duplicate_detection():
+    pub, sub = _pair()
+    try:
+        vdi, meta = _vdi_meta()
+        for i in range(2):
+            pub.publish(vdi, meta._replace(index=np.int32(i)))
+        pub._next_seq()                        # simulate one lost message
+        pub.publish(vdi, meta._replace(index=np.int32(2)))
+        received, drops = _drain(sub)
+        assert len(received) == 3 and drops == []
+        assert sub.stats["gaps"] == 1
+        assert any(e["component"] == "stream.gap" for e in obs.ledger())
+        # duplicates: replay the same seq → stale drop, frame not doubled
+        inject(pub, FaultSpec(duplicate=1.0), seed=0)
+        pub.publish(vdi, meta._replace(index=np.int32(3)))
+        received, drops = _drain(sub, timeout_s=2.0)
+        assert len(received) == 1
+        assert len(drops) == 1 and drops[0].kind == "stale"
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_epoch_change_resets_continuity():
+    """A restarted publisher (new epoch, seq reset) must not flood the
+    gap accounting — tracking resets on the epoch boundary."""
+    pub, sub = _pair()
+    try:
+        vdi, meta = _vdi_meta()
+        for i in range(3):
+            pub.publish(vdi, meta._replace(index=np.int32(i)))
+        _drain(sub)
+        pub2 = VDIPublisher("tcp://127.0.0.1:0", codec="zlib")
+        sub2 = VDISubscriber(pub2.endpoint)
+        time.sleep(0.2)
+        # same subscriber-side logic, fresh pub: simulate via epoch swap
+        pub.epoch, pub.seq = pub.epoch + 1, 0
+        pub.publish(vdi, meta._replace(index=np.int32(0)))
+        received, drops = _drain(sub, timeout_s=2.0)
+        assert len(received) == 1 and drops == []
+        assert sub.stats["epoch_changes"] == 1
+        pub2.close()
+        sub2.close()
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_heartbeats_keep_continuity_and_never_surface():
+    pub, sub = _pair()
+    try:
+        vdi, meta = _vdi_meta()
+        pub.publish(vdi, meta)
+        pub.heartbeat()
+        pub.heartbeat()
+        pub.publish(vdi, meta._replace(index=np.int32(1)))
+        received, drops = _drain(sub)
+        assert len(received) == 2 and drops == []
+        assert sub.stats["heartbeats"] == 2
+        assert sub.stats["gaps"] == 0          # hb seqs fill the gaps
+        assert pub.maybe_heartbeat() is False  # just sent
+        pub.fault = FaultConfig(heartbeat_period_s=0.01)
+        time.sleep(0.03)
+        assert pub.maybe_heartbeat() is True
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_clean_path_bit_exact_and_header_overhead():
+    """Acceptance: no faults → bit-identical f32 decode; header < 1% of
+    frame bytes at a realistic frame size."""
+    pub, sub = _pair()
+    try:
+        rng = np.random.default_rng(5)
+        vdi = VDI(rng.random((8, 4, 48, 64)).astype(np.float32),
+                  rng.random((8, 2, 48, 64)).astype(np.float32))
+        meta = VDIMetadata.create(np.eye(4), np.eye(4),
+                                  volume_dims=(32, 32, 32),
+                                  window_dims=(64, 48), nw=0.1, index=0)
+        pub.publish(vdi, meta)
+        got = sub.receive(timeout_ms=5000)
+        assert got is not None and not isinstance(got, StreamDrop)
+        np.testing.assert_array_equal(np.asarray(vdi.color), got[0].color)
+        np.testing.assert_array_equal(np.asarray(vdi.depth), got[0].depth)
+        raw = np.asarray(vdi.color).nbytes + np.asarray(vdi.depth).nbytes
+        assert pub.last_bytes["header"] < 0.01 * raw, pub.last_bytes
+    finally:
+        pub.close()
+        sub.close()
+
+
+# ---------------------------------------------------------- tile streams
+
+def test_frame_assembler_completes_and_abandons():
+    vdi, meta = _vdi_meta()
+    color, depth = np.asarray(vdi.color), np.asarray(vdi.depth)
+    asm = FrameAssembler(window=2)
+    ntiles, wb = 4, W // 4
+
+    def tiles_of(f, skip=()):
+        out = []
+        for t in range(ntiles):
+            if t in skip:
+                continue
+            tv = VDI(color[..., t * wb:(t + 1) * wb],
+                     depth[..., t * wb:(t + 1) * wb])
+            out.append((tv, meta._replace(index=np.int32(f)),
+                        {"tile": t, "tiles": ntiles, "col0": t * wb}))
+        return out
+
+    # frame 0 complete -> assembles bit-exactly
+    done = [asm.add(*m) for m in tiles_of(0)]
+    full = [d for d in done if d is not None]
+    assert len(full) == 1
+    np.testing.assert_array_equal(color, full[0][0].color)
+    # frame 1 loses tile 2; frames 2..4 complete -> 1 abandoned
+    for m in tiles_of(1, skip=(2,)):
+        asm.add(*m)
+    for f in (2, 3, 4):
+        [asm.add(*m) for m in tiles_of(f)]
+    assert asm.stats["abandoned"] == 1
+    assert asm.stats["assembled"] == 4
+    assert any(e["component"] == "stream.gap" for e in obs.ledger())
+    # a straggler tile of the abandoned frame must NOT re-create (and
+    # re-abandon) it — counted as late, abandoned stays 1
+    assert asm.add(*tiles_of(1)[2]) is None
+    assert asm.stats["late_tiles"] == 1
+    assert asm.stats["abandoned"] == 1
+    # whole-frame messages pass straight through
+    out = asm.add(vdi, meta, None)
+    assert out is not None and out[0] is vdi
+
+
+# ------------------------------------------------------------- steering
+
+def test_steering_drain_survives_malformed_and_oversized():
+    """Satellite: SteeringEndpoint.drain catches per message, ledgers
+    stream.steering, caps message size, keeps draining."""
+    ep = SteeringEndpoint("tcp://127.0.0.1:0",
+                          fault=FaultConfig(max_message_bytes=2048))
+    viewer = SteeringPublisher(ep.endpoint)
+    try:
+        time.sleep(0.3)
+        good = []
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not good:
+            viewer.sock.send(b"\x82\x01 definitely not msgpack \xff")
+            viewer.sock.send(b"\x00" * 4096)           # over the cap
+            viewer.sock.send(b"\x01")                  # not a map
+            viewer.heartbeat()                         # consumed silently
+            viewer.send({"type": "camera", "eye": [1, 2, 3]})
+            time.sleep(0.02)
+            good.extend(ep.drain())
+        assert good and all(m["type"] == "camera" for m in good)
+        assert ep.stats["dropped"] >= 3
+        assert ep.stats["heartbeats"] >= 1
+        assert any(e["component"] == "stream.steering"
+                   for e in obs.ledger())
+    finally:
+        viewer.close()
+        ep.close()
+
+
+# ------------------------------------------------------------- liveness
+
+def test_subscriber_reconnects_with_backoff():
+    sub = VDISubscriber("tcp://127.0.0.1:1",
+                        fault=FaultConfig(liveness_timeout_s=0.05,
+                                          backoff_base_s=0.01,
+                                          backoff_cap_s=0.05))
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and sub.stats["reconnects"] < 2:
+            sub.receive(timeout_ms=30)
+        assert sub.stats["reconnects"] >= 2
+        assert any(e["component"] == "stream.liveness"
+                   for e in obs.ledger())
+    finally:
+        sub.close()
+
+
+def test_background_heartbeats_prevent_reconnect_churn():
+    """A supervised subscriber on an idle-but-alive publisher must NOT
+    reconnect when the publisher pumps background heartbeats."""
+    pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib",
+                       fault=FaultConfig(heartbeat_period_s=0.05))
+    sub = VDISubscriber(pub.endpoint,
+                        fault=FaultConfig(liveness_timeout_s=0.4,
+                                          backoff_base_s=0.01,
+                                          backoff_cap_s=0.05))
+    try:
+        time.sleep(0.2)                       # SUB join settles
+        pub.start_heartbeats()
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            got = sub.receive(timeout_ms=50)
+            assert got is None or isinstance(got, StreamDrop) is False
+        assert sub.stats["heartbeats"] > 0
+        assert sub.stats["reconnects"] == 0   # alive, just idle
+        # and a frame published concurrently with the pump still decodes
+        vdi, meta = _vdi_meta()
+        pub.publish(vdi, meta)
+        got = None
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and got is None:
+            got = sub.receive(timeout_ms=100)
+        assert got is not None and not isinstance(got, StreamDrop)
+        np.testing.assert_array_equal(np.asarray(vdi.color), got[0].color)
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_reconnected_subscriber_still_receives():
+    pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib")
+    sub = VDISubscriber(pub.endpoint,
+                        fault=FaultConfig(liveness_timeout_s=0.05,
+                                          backoff_base_s=0.01,
+                                          backoff_cap_s=0.05))
+    try:
+        vdi, meta = _vdi_meta()
+        # silence past the deadline forces at least one reconnect
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not sub.stats["reconnects"]:
+            sub.receive(timeout_ms=30)
+        assert sub.stats["reconnects"] >= 1
+        # stop further supervised teardowns so the fresh SUB join can
+        # settle — the drill is "reconnected socket still receives"
+        sub.fault = FaultConfig(liveness_timeout_s=60.0)
+        got = None
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and got is None:
+            pub.publish(vdi, meta)         # resend until SUB rejoins
+            time.sleep(0.05)
+            got = sub.receive(timeout_ms=100)
+            if isinstance(got, StreamDrop):
+                got = None                  # post-reconnect gap records
+        assert got is not None
+        np.testing.assert_array_equal(np.asarray(vdi.color), got[0].color)
+    finally:
+        pub.close()
+        sub.close()
+
+
+# ------------------------------------------------------ sink quarantine
+
+def _tiny_cfg(*extra):
+    return FrameworkConfig().with_overrides(
+        "render.width=32", "render.height=24", "render.max_steps=16",
+        "sim.grid=[12,12,12]", "sim.steps_per_frame=1",
+        "vdi.max_supersegments=4", "vdi.adaptive_iters=1",
+        "composite.max_output_supersegments=4",
+        "composite.adaptive_iters=1", *extra)
+
+
+def test_failing_sink_is_quarantined_session_survives():
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.runtime.session import InSituSession
+
+    calls = {"bad": 0, "good": 0}
+
+    def bad_sink(i, p):
+        calls["bad"] += 1
+        raise RuntimeError("sink boom")
+
+    def good_sink(i, p):
+        calls["good"] += 1
+
+    cfg = _tiny_cfg("fault.max_sink_failures=2")
+    sess = InSituSession(cfg, mesh=make_mesh(2),
+                         sinks=[bad_sink, good_sink])
+    payload = sess.run(5)
+    assert np.isfinite(payload["vdi_color"]).all()
+    assert calls["bad"] == 2                  # quarantined after 2
+    assert calls["good"] == 5                 # never starved
+    assert sess._sink_guard.is_quarantined(bad_sink)
+    assert any(e["component"] == "session.sink" for e in obs.ledger())
+
+
+def test_transient_sink_failures_reset_on_success():
+    from scenery_insitu_tpu.runtime.failsafe import SinkGuard
+
+    n = {"fails": 0}
+
+    def flaky(i, p):
+        n["fails"] += 1
+        if n["fails"] % 2:                    # fail, succeed, fail, ...
+            raise RuntimeError("transient")
+
+    guard = SinkGuard(max_failures=2)
+    for i in range(8):
+        guard.call(flaky, i, {})
+    assert not guard.is_quarantined(flaky)    # never 2 in a row
+
+
+def test_throwing_on_steer_callback_contained():
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.runtime.session import InSituSession
+    from scenery_insitu_tpu.runtime.streaming import (SteeringEndpoint,
+                                                      SteeringPublisher)
+
+    cfg = _tiny_cfg("fault.max_sink_failures=3")
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    ep = SteeringEndpoint("tcp://127.0.0.1:0")
+    viewer = SteeringPublisher(ep.endpoint)
+    sess.steering = ep
+    seen = []
+
+    def boom(msg):
+        raise RuntimeError("callback boom")
+
+    sess.on_steer.insert(0, boom)             # before the tf handler
+    sess.on_steer.append(seen.append)
+    try:
+        time.sleep(0.3)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not seen:
+            viewer.send({"type": "record", "on": True})
+            time.sleep(0.05)
+            sess.run(1)                       # must not raise
+        assert seen and seen[0]["type"] == "record"
+    finally:
+        viewer.close()
+        ep.close()
+
+
+# ----------------------------------------------------- head node liveness
+
+def test_head_marks_silent_rank_down_and_readmits():
+    from scenery_insitu_tpu.runtime.head import HeadNode, RankImageSender
+
+    got = []
+    head = HeadNode(2, bind="tcp://*:0", stale_frames=2,
+                    sinks=(lambda i, p: got.append((i, p)),))
+    try:
+        ep = head.endpoint.replace("*", "localhost")
+        s0 = RankImageSender(0, ep)
+        s1 = SilentRank(RankImageSender(1, ep), after=2, resume_at=8)
+        h, w = 8, 12
+        img = np.zeros((4, h, w), np.float32)
+        img[3] = 1.0
+        dep = np.ones((h, w), np.float32)
+        time.sleep(0.2)
+        for f in range(12):
+            s0.send(f, img, dep)
+            s1.send(f, img, dep)
+            head.pump(timeout_ms=50)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and head.frames_composited < 10:
+            head.pump(timeout_ms=100)
+        frames = {i for i, _ in got}
+        # rank 1 was silent for frames 2..7: those frames composited
+        # DEGRADED without it, flagged in the payload
+        degraded = {i for i, p in got if p.get("degraded")}
+        complete = {i for i, p in got if not p.get("degraded")}
+        assert degraded, got
+        assert all(p["missing_ranks"] == [1]
+                   for i, p in got if p.get("degraded"))
+        assert any(e["component"] == "head.rank_down"
+                   for e in obs.ledger())
+        # re-admission: frames >= 8 complete again with both ranks
+        assert complete & {f for f in frames if f >= 8}
+        assert head.frames_degraded == len(degraded)
+    finally:
+        s0.close()
+        s1.close()
+        head.close()
+
+
+def test_head_survives_malformed_rank_message():
+    from scenery_insitu_tpu.runtime.head import HeadNode, RankImageSender
+
+    head = HeadNode(1, bind="tcp://*:0")
+    try:
+        ep = head.endpoint.replace("*", "localhost")
+        s = RankImageSender(0, ep)
+        time.sleep(0.2)
+        s.sock.send_multipart([b"not msgpack at all", b"x", b"y"])
+        s.sock.send_multipart([b"short"])
+        h, w = 4, 6
+        img = np.zeros((4, h, w), np.float32)
+        dep = np.ones((h, w), np.float32)
+        s.send(0, img, dep)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not head.frames_composited:
+            head.pump(timeout_ms=100)
+        assert head.frames_composited == 1
+        assert any(e["component"] == "stream.integrity"
+                   for e in obs.ledger())
+        s.close()
+    finally:
+        head.close()
+
+
+def test_head_refuses_ragged_cross_rank_shapes():
+    """A parseable message whose image shape disagrees with the frame's
+    other ranks must drop at intake — not kill the pump in np.stack."""
+    from scenery_insitu_tpu.runtime.head import HeadNode, RankImageSender
+
+    got = []
+    head = HeadNode(2, bind="tcp://*:0", stale_frames=2,
+                    sinks=(lambda i, p: got.append((i, p)),))
+    try:
+        ep = head.endpoint.replace("*", "localhost")
+        s0 = RankImageSender(0, ep)
+        s1 = RankImageSender(1, ep)
+        time.sleep(0.2)
+        img = np.zeros((4, 8, 12), np.float32)
+        dep = np.ones((8, 12), np.float32)
+        wide = np.zeros((4, 8, 24), np.float32)   # ragged vs rank 0
+        wdep = np.ones((8, 24), np.float32)
+        for f in range(6):
+            s0.send(f, img, dep)
+            s1.send(f, wide if f == 0 else img, wdep if f == 0 else dep)
+            head.pump(timeout_ms=50)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and head.frames_composited < 5:
+            head.pump(timeout_ms=100)          # must never raise
+        assert head.frames_composited >= 5
+        # the ragged contribution for frame 0 was refused, so frame 0
+        # either shipped degraded (rank 0 only) or complete later —
+        # never crashed the pump
+        assert any(e["component"] == "stream.integrity"
+                   for e in obs.ledger())
+        s0.close()
+        s1.close()
+    finally:
+        head.close()
+
+
+def test_head_recovers_from_absurd_frame_index():
+    """One corrupt-but-parseable frame counter must not poison liveness
+    and eviction forever — the head resets its stream bookkeeping and
+    keeps compositing real frames."""
+    from scenery_insitu_tpu.runtime.head import HeadNode, RankImageSender
+
+    head = HeadNode(1, bind="tcp://*:0", stale_frames=2)
+    try:
+        ep = head.endpoint.replace("*", "localhost")
+        s = RankImageSender(0, ep)
+        time.sleep(0.2)
+        img = np.zeros((4, 4, 6), np.float32)
+        dep = np.ones((4, 6), np.float32)
+        s.send(0, img, dep)
+        s.send(10 ** 9, img, dep)              # absurd jump: state reset
+        for f in range(1, 5):
+            s.send(f, img, dep)                # real frames keep flowing
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and head.frames_composited < 5:
+            head.pump(timeout_ms=100)
+        # frame 0 + the 4 post-jump real frames all shipped (the absurd
+        # frame itself also composites once — it is indistinguishable
+        # from a legitimate sender restart)
+        assert head.frames_composited >= 5
+        assert any(e["component"] == "stream.gap" for e in obs.ledger())
+        s.close()
+    finally:
+        head.close()
+
+
+# ------------------------------------------------------- chaos injectors
+
+def test_chaos_socket_deterministic():
+    sent = []
+
+    class FakeSock:
+        def send_multipart(self, parts):
+            sent.append(tuple(parts))
+
+    def run(seed):
+        sent.clear()
+        cs = ChaosSocket(FakeSock(), FaultSpec(drop=0.4, corrupt=0.3),
+                         seed=seed)
+        for i in range(20):
+            cs.send_multipart([b"h", bytes([i] * 8), b"d"])
+        cs.flush()
+        return list(sent), dict(cs.report.injected)
+
+    a_msgs, a_rep = run(11)
+    b_msgs, b_rep = run(11)
+    c_msgs, c_rep = run(12)
+    assert a_msgs == b_msgs and a_rep == b_rep   # same seed, same faults
+    assert a_rep != c_rep or a_msgs != c_msgs    # different seed differs
+    assert a_rep.get("drop", 0) > 0 and a_rep.get("corrupt", 0) > 0
+
+
+def test_chaos_matrix_runs_green():
+    """The CI chaos lane's matrix, in-process: >= 8 injector × endpoint
+    combinations, every one alive with its expected ledger row."""
+    report = run_matrix(seed=1, frames=10)
+    assert len(report["scenarios"]) >= 8
+    bad = [s for s in report["scenarios"] if not s["ok"]]
+    assert report["ok"], bad
+
+
+# ------------------------------------------------------ video wraparound
+
+def test_video_receiver_survives_frame_id_wraparound():
+    """Satellite: the u32 frame counter wraps; eviction and completion
+    must keep working across the boundary (no leak, no misorder)."""
+    pytest.importorskip("cv2")
+    from scenery_insitu_tpu.runtime.streaming import (VideoReceiver,
+                                                      VideoStreamer)
+
+    rx = VideoReceiver(port=0, timeout_s=2.0)
+    tx = VideoStreamer(port=rx.port, quality=85)
+    try:
+        tx.CHUNK = 512                        # force multi-datagram
+        tx.frame_id = 2 ** 32 - 2
+        img = np.zeros((4, 32, 48), np.float32)
+        img[3] = 1.0
+        got = 0
+        for _ in range(4):                    # crosses the wrap at 2^32
+            assert tx.send_frame(img) > 0
+            if rx.receive_frame() is not None:
+                got += 1
+        assert got == 4
+        assert tx.frame_id == 2               # wrapped, not 2^32 + 2
+        assert len(rx._parts) == 0            # nothing leaked
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_seq_delta_wraparound():
+    assert seq_delta(5, 3) == 2
+    assert seq_delta(3, 5) == -2
+    assert seq_delta(1, 2 ** 32 - 1) == 2     # across the wrap
+    assert seq_delta(2 ** 32 - 1, 1) == -2
+    assert seq_delta(0, 0) == 0

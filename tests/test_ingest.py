@@ -138,6 +138,86 @@ def test_blocking_wait_wakes_on_publish():
         prod.close()
 
 
+def test_shm_source_stall_and_recover():
+    """Satellite (ISSUE 11): a stalled/dead producer must not kill the
+    render loop — ShmVolumeSource keeps rendering last-good data under
+    an `ingest.stall` ledger row, polls without blocking while stalled,
+    and recovers the moment frames resume."""
+    from scenery_insitu_tpu import obs
+    from scenery_insitu_tpu.ingest.shm import ShmVolumeSource
+
+    shape = (6, 6, 6)
+    ch = _chan()
+    prod = ShmProducer(ch, shape)
+    prod.publish(np.full(shape, 1.0, np.float32))
+    src = ShmVolumeSource(ch, shape, timeout_ms=2000,
+                          frame_timeout_ms=100, device_put=False)
+    try:
+        src.advance(1)
+        np.testing.assert_array_equal(np.asarray(src.field),
+                                      np.full(shape, 1.0, np.float32))
+        assert not src.stalled
+        # producer goes quiet: the source stalls, keeps last-good data
+        src.advance(1)
+        assert src.stalled and src.stall_count == 1
+        assert any(e["component"] == "ingest.stall"
+                   for e in obs.ledger())
+        np.testing.assert_array_equal(np.asarray(src.field),
+                                      np.full(shape, 1.0, np.float32))
+        # while stalled, advance polls non-blocking (no 100 ms waits)
+        t0 = time.monotonic()
+        for _ in range(5):
+            src.advance(1)
+        assert time.monotonic() - t0 < 0.4
+        assert src.stall_count == 1          # one episode, minted once
+        # frames resume: the stall clears and new data renders
+        prod.publish(np.full(shape, 2.0, np.float32))
+        src.advance(1)
+        assert not src.stalled
+        np.testing.assert_array_equal(np.asarray(src.field),
+                                      np.full(shape, 2.0, np.float32))
+    finally:
+        src.consumer.close()
+        prod.close()
+
+
+def test_sharded_source_stall_keeps_last_good():
+    """The multi-rank twin: a silent producer SET stalls the sharded
+    source onto last-good data (ledgered), without blocking the loop."""
+    from scenery_insitu_tpu import obs
+    from scenery_insitu_tpu.ingest.shm import ShmShardedVolumeSource
+
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 1:
+        pytest.skip("no devices")
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(1)
+    shape = (4, 4, 4)
+    ch = _chan()
+    prod = ShmProducer(ch, shape)
+    prod.publish(np.full(shape, 3.0, np.float32))
+    src = ShmShardedVolumeSource([ch], shape, mesh, timeout_ms=2000,
+                                 frame_timeout_ms=100)
+    try:
+        src.advance()
+        assert float(np.asarray(src.field)[0, 0, 0]) == 3.0
+        src.advance()                        # nothing newer -> stall
+        assert src.stalled
+        assert any(e["component"] == "ingest.stall"
+                   for e in obs.ledger())
+        t0 = time.monotonic()
+        src.advance()                        # stalled advances don't block
+        assert time.monotonic() - t0 < 0.4
+        prod.publish(np.full(shape, 4.0, np.float32))
+        src.advance()
+        assert not src.stalled
+        assert float(np.asarray(src.field)[0, 0, 0]) == 4.0
+    finally:
+        src.close()
+        prod.close()
+
+
 def test_cpp_demo_producer_field_mode():
     """Consume frames produced by the standalone C++ simulation binary —
     the true cross-language operator boundary."""

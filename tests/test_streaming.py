@@ -309,6 +309,50 @@ def test_streamed_mxu_vdi_client_renders_novel_view():
         sub.close()
 
 
+def test_subscriber_drops_corrupt_blob_without_raising():
+    """Satellite (ISSUE 11): a corrupt/truncated blob used to crash
+    receive_tile on np.frombuffer(...).reshape(...); now it fails the
+    CRC/byte-count validation BEFORE decode and comes back as a typed
+    StreamDrop."""
+    from scenery_insitu_tpu.runtime.streaming import StreamDrop
+
+    pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib")
+    sub = VDISubscriber(pub.endpoint)
+    try:
+        _sync_pubsub(pub, sub)
+        vdi, meta = _vdi_meta()
+
+        class _Corrupting:
+            def __init__(self, sock):
+                self.sock = sock
+
+            def send_multipart(self, parts):
+                parts = list(parts)
+                blob = bytearray(parts[1])
+                blob[len(blob) // 2] ^= 0xFF        # one flipped byte
+                parts[1] = bytes(blob)
+                self.sock.send_multipart(parts)
+
+            def __getattr__(self, name):
+                return getattr(self.sock, name)
+
+        inner = pub.sock
+        pub.sock = _Corrupting(inner)
+        pub.publish(vdi, meta)
+        got = sub.receive_tile(timeout_ms=5000)
+        assert isinstance(got, StreamDrop)
+        assert got.kind == "integrity"
+        # clean frames keep flowing on the same socket afterwards
+        pub.sock = inner
+        pub.publish(vdi, meta)
+        got = sub.receive(timeout_ms=5000)
+        assert got is not None and not isinstance(got, StreamDrop)
+        np.testing.assert_array_equal(np.asarray(vdi.color), got[0].color)
+    finally:
+        pub.close()
+        sub.close()
+
+
 def test_tf_message_roundtrip():
     from scenery_insitu_tpu.runtime.streaming import (make_tf_message,
                                                       tf_from_message)

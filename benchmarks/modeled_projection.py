@@ -199,10 +199,53 @@ def main():
             "note": note,
         })
 
+    # ---- steady-state temporal-delta scenario (ISSUE 12): frames are
+    # coherent, so the march term scales with WHAT CHANGED. Clean ranks
+    # skip their march entirely (temporal_reuse="ranges"; the dirty
+    # detector reads the sim-fused ranges already in the stack, so a
+    # skipped frame pays no extra sweep). skip_frac is the measured
+    # slow-scene tile fraction from the committed delta_ab artifact.
+    dab = _load("delta_ab_r12_cpu.json", {})
+    slow = (dab.get("scenes") or {}).get("slow", {})
+    skip_frac = float((slow.get("march") or {}).get("skip_frac", 0.75))
+    wire_ratio = float((slow.get("wire") or {}).get("payload_ratio",
+                                                    0.25))
+    # base on the balanced-scene full ladder row BY NAME — positional
+    # indexing rots silently as scenario rows accrete around it
+    full_stack = next(r for r in stack if r["lever"] == "+tile_waves")
+    ms = dict(full_stack["ms"])
+    ms["march"] = round(ms["march"] * (1.0 - skip_frac), 2)
+    stack.append({
+        "lever": "steady_scene_temporal_reuse",
+        "config": {**full_stack["config"],
+                   "scenario": "steady-state (slow-evolving)",
+                   "temporal_reuse": "ranges",
+                   "skip_frac": skip_frac},
+        "bytes": full_stack["bytes"],
+        "ms": ms,
+        "modeled_ms_per_frame": round(sum(ms.values()), 2),
+        "note": f"SCENARIO row: dirty-tile re-march (ISSUE 12) on a "
+                f"slow-evolving scene — {skip_frac:.0%} of tiles reuse "
+                f"last frame's fragments (measured slow-scene skip "
+                f"fraction, delta_bench CPU A/B); the win scales with "
+                f"run steadiness, not grid size",
+    })
+
     b0 = stack[0]["modeled_ms_per_frame"]
     for r_ in stack:
         r_["speedup_vs_baseline"] = round(b0 / r_["modeled_ms_per_frame"],
                                           2)
+
+    from scenery_insitu_tpu.ops.delta import modeled_delta_traffic
+
+    delta_wire = modeled_delta_traffic(
+        K, NJ, NI, skip_frac=skip_frac,
+        p_frac=max(0.0, 1.0 - skip_frac - 1.0 / RANKS), iframe_period=8)
+    delta_wire["measured_slow_scene_payload_ratio"] = wire_ratio
+    delta_wire["source"] = ("benchmarks/results/delta_ab_r12_cpu.json "
+                            "(slow scene; compressed record payloads — "
+                            "headers are constant per message and "
+                            "vanish at flagship tile sizes)")
 
     out = {
         "metric": f"modeled_projection_{RANKS:02d}rank_config2_{GRID}",
@@ -234,8 +277,13 @@ def main():
                                     "keeps sim and render terms "
                                     "separate so either outcome maps "
                                     "onto a subset of rows",
+            "delta_skip_frac_source":
+                "benchmarks/results/delta_ab_r12_cpu.json (slow scene; "
+                "assumption: steady in-situ runs look like the "
+                "slow-evolving scene most frames)",
         },
         "stack": stack,
+        "delta_wire_steady_state": delta_wire,
     }
     print(json.dumps(out))
     if args.out:

@@ -67,6 +67,22 @@ def fmt(r: dict) -> str:
                     f"  {pk}: max|dcolor|="
                     f"{r[pk].get('max_abs_diff_color')}")
         return "\n   ".join(lines)
+    if r.get("kind") == "delta_ab":              # temporal-delta A/B
+        lines = [f"delta_ab: [{r.get('platform', '?')}] "
+                 f"verdicts={r.get('verdicts')}"]
+        for name, sc in sorted((r.get("scenes") or {}).items()):
+            m, w = sc.get("march", {}), sc.get("wire", {})
+            lines.append(
+                f"  {name:5s} march {m.get('ms_per_frame_off')} -> "
+                f"{m.get('ms_per_frame_on')} ms/frame, skip "
+                f"{m.get('skip_frac')}")
+            if "bytes_ratio" in w:
+                lines.append(
+                    f"  {name:5s} wire  {w.get('bytes_per_frame_qpack8')}"
+                    f" -> {w.get('bytes_per_frame_delta')} B/frame "
+                    f"(x{w.get('bytes_ratio')}), records {w.get('records')}"
+                    f", bitexact={w.get('recon_bitexact_vs_qpack8')}")
+        return "\n   ".join(lines)
     if "plan" in r and "even" in r and "occupancy" in r:   # rebalance A/B
         ev, oc = r["even"], r["occupancy"]
         return (f"{r.get('metric', 'rebalance_ab')}: straggler "

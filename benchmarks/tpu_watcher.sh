@@ -132,6 +132,12 @@ run_step() {
          python benchmarks/rank_slab_bench.py --rebalance both \
          --grid 256 --iters 3 \
          --out "$R/rebalance_ab_tpu_${ROUND}.json" ;;
+    # temporal-delta A/B on real devices (docs/PERF.md "Temporal
+    # deltas"; the committed CPU capture is delta_ab_r12_cpu)
+    12) run_json "$R/delta_ab_tpu_${ROUND}.json" 1200 env \
+         SITPU_BENCH_REAL=1 python benchmarks/delta_bench.py \
+         --grid 128 --frames 12 \
+         --out "$R/delta_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
@@ -148,10 +154,11 @@ step_out() {
     9) echo "$R/bench_tpu_${ROUND}_512_scanloop.json" ;;
     10) echo "$R/bench_tpu_${ROUND}_1024.json" ;;
     11) echo "$R/rebalance_ab_tpu_${ROUND}.json" ;;
+    12) echo "$R/delta_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=11
+NSTEPS=12
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

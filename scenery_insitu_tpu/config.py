@@ -265,6 +265,25 @@ class CompositeConfig:
     #                fractions; a plan CHANGE recompiles the step — the
     #                quantum + hysteresis below bound how often.
     rebalance: str = "even"
+    # Temporal fragment reuse (docs/PERF.md "Temporal deltas"):
+    #   "off"     every frame re-marches every rank (the pre-ISSUE-12
+    #             behavior — the off path inserts zero ops);
+    #   "ranges"  each rank carries its previous marched VDI fragment
+    #             plus a dirty signature — the occupancy pyramid's
+    #             per-cell [lo, hi] value ranges (already computed every
+    #             frame, PR 6) concatenated with the camera pose — and
+    #             SKIPS the march (lax.cond; the matmul waves never
+    #             issue) when the signature moved by at most
+    #             delta.range_tol and the camera is bit-unchanged. The
+    #             exchange + composite still run every frame (other
+    #             ranks may be dirty). MXU VDI steps only; the gather /
+    #             hybrid / plain builders ledger the knob inert
+    #             (delta.reuse). range_tol = 0 with a static camera is
+    #             bit-exact vs recompute; a field change that preserves
+    #             every per-brick [lo, hi] exactly is invisible to the
+    #             detector — the documented contract of a range-based
+    #             dirty predicate.
+    temporal_reuse: str = "off"
     # Frames between host-side re-plans under rebalance="occupancy"
     # (runtime/session.py fetches the z live profile and re-plans every
     # this many frames; each ADOPTED plan recompiles the step).
@@ -307,6 +326,9 @@ class CompositeConfig:
         if self.rebalance not in ("even", "occupancy"):
             raise ValueError(f"rebalance must be 'even' or 'occupancy', "
                              f"got {self.rebalance!r}")
+        if self.temporal_reuse not in ("off", "ranges"):
+            raise ValueError(f"temporal_reuse must be 'off' or 'ranges', "
+                             f"got {self.temporal_reuse!r}")
         if self.rebalance_period < 1:
             raise ValueError(f"rebalance_period must be >= 1, "
                              f"got {self.rebalance_period}")
@@ -461,6 +483,42 @@ class FaultConfig:
 
 
 @dataclass(frozen=True)
+class DeltaConfig:
+    """Temporal-delta plane (docs/PERF.md "Temporal deltas"): steady
+    frames cost bytes and FLOPs proportional to what changed.
+
+    ``enabled`` turns on the P-frame WIRE codec on `VDIPublisher`
+    (requires ``precision="qpack8"`` — the monotone quantizer is what
+    makes code-space comparison exact): per published tile the stream
+    carries a SKIP record, a sparse changed-slot residual, or a full
+    I-tile, and subscribers reconstruct bit-exactly (ops/delta.py).
+    The RE-MARCH half is switched separately by
+    ``composite.temporal_reuse`` (it changes the compiled step's
+    signature); ``range_tol`` is its dirty-detector tolerance."""
+
+    # P-frame wire codec on VDIPublisher/VDISubscriber.
+    enabled: bool = False
+    # Forced I-tile cadence, frames: a joining subscriber or a stream
+    # that dropped a record recovers within one period (the subscriber
+    # ledgers the wait as stream.delta_resync). Smaller = faster
+    # recovery, more bytes.
+    iframe_period: int = 8
+    # Dirty-detector tolerance of composite.temporal_reuse = "ranges":
+    # a rank re-marches only when some occupancy-range cell moved by
+    # more than this (absolute, field units). 0 = exact mode — any
+    # range motion re-marches and reuse is bitwise vs recompute.
+    range_tol: float = 0.0
+
+    def __post_init__(self):
+        if self.iframe_period < 1:
+            raise ValueError(f"iframe_period must be >= 1, "
+                             f"got {self.iframe_period}")
+        if self.range_tol < 0.0:
+            raise ValueError(f"range_tol must be >= 0, "
+                             f"got {self.range_tol}")
+
+
+@dataclass(frozen=True)
 class StreamConfig:
     """Steering / streaming endpoints (≅ ZMQ :6655 + UDP :3337,
     VolumeFromFileExample.kt:840-854; DistributedVolumeRenderer.kt:278-283)."""
@@ -483,6 +541,7 @@ class FrameworkConfig:
     stream: StreamConfig = field(default_factory=StreamConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
+    delta: DeltaConfig = field(default_factory=DeltaConfig)
 
     # ------------------------------------------------------------------ IO
     def to_dict(self) -> dict:

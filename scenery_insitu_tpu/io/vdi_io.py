@@ -256,6 +256,86 @@ def load_vdi_tile(path: str) -> Tuple[VDI, Optional[VDIMetadata],
     return vdi, meta, tile
 
 
+# ------------------------------------------------- temporal-delta records
+
+def pack_delta_blobs(rec, codec: str = "zstd", level: int = -1
+                     ) -> Tuple[dict, bytes, bytes]:
+    """Serialize one ``ops/delta.DeltaRecord`` into the VDI stream's
+    3-part wire convention (docs/PERF.md "Temporal deltas"): returns
+    ``(header_fields, color_blob, depth_blob)`` where ``header_fields``
+    is the ``delta`` header dict (mode/gen/base + the P residual's run
+    and value counts, needed to re-split the blobs) and the blobs are
+    codec-compressed payload bytes — full code arrays for I, the
+    concatenated ``starts | lengths | values`` residual streams for P,
+    empty for SKIP. The CRC/byte-count validation contract is unchanged:
+    checksums are of these wire blobs."""
+    codec = resolve_codec(codec)
+    h = {"mode": rec.mode, "gen": int(rec.gen), "base": int(rec.base_gen)}
+    if rec.mode == "I":
+        cblob = compress(rec.c_payload[0].tobytes(), codec, level)
+        dblob = compress(rec.d_payload[0].tobytes(), codec, level)
+    elif rec.mode == "P":
+        cs, cl, cv = rec.c_payload
+        ds, dl, dv = rec.d_payload
+        h.update(c_runs=int(cs.size), c_n=int(cv.size),
+                 d_runs=int(ds.size), d_n=int(dv.size))
+        cblob = compress(cs.tobytes() + cl.tobytes() + cv.tobytes(),
+                         codec, level)
+        dblob = compress(ds.tobytes() + dl.tobytes() + dv.tobytes(),
+                         codec, level)
+    elif rec.mode == "SKIP":
+        cblob = dblob = b""
+    else:
+        raise ValueError(f"unknown delta mode {rec.mode!r}")
+    return h, cblob, dblob
+
+
+def delta_expected_bytes(dh: dict, cshape: Tuple[int, ...],
+                         dshape: Tuple[int, ...]) -> Tuple[int, int]:
+    """Decompressed byte counts a delta message's blobs must have —
+    the shape-vs-bytes validation twin of the full-frame path (the
+    declared ``color_shape``/``depth_shape`` always describe the FULL
+    tile, so reconstruction and assembly stay shape-stable)."""
+    mode = dh.get("mode")
+    if mode == "I":
+        return (int(np.prod(cshape)) * 4, int(np.prod(dshape)) * 2)
+    if mode == "P":
+        return (int(dh["c_runs"]) * 8 + int(dh["c_n"]) * 4,
+                int(dh["d_runs"]) * 8 + int(dh["d_n"]) * 2)
+    if mode == "SKIP":
+        return 0, 0
+    raise ValueError(f"unknown delta mode {mode!r}")
+
+
+def unpack_delta_payload(dh: dict, craw: bytes, draw: bytes,
+                         cshape: Tuple[int, ...], dshape: Tuple[int, ...]
+                         ) -> Tuple[tuple, tuple]:
+    """Inverse of `pack_delta_blobs` (after decompression + byte-count
+    validation): returns the ``(c_payload, d_payload)`` tuples
+    ``ops/delta.DeltaDecoder.apply`` consumes."""
+    mode = dh["mode"]
+    if mode == "SKIP":
+        return (), ()
+    if mode == "I":
+        return ((np.frombuffer(craw, np.uint32).reshape(cshape),),
+                (np.frombuffer(draw, np.uint16).reshape(dshape),))
+    if mode != "P":
+        raise ValueError(f"unknown delta mode {mode!r}")
+
+    def split(raw, runs, n, vdtype):
+        b = np.frombuffer(raw, np.uint8)
+        starts = b[:runs * 4].view(np.uint32)
+        lengths = b[runs * 4:runs * 8].view(np.uint32)
+        values = b[runs * 8:].view(vdtype)
+        if values.size != n:
+            raise ValueError(f"residual carries {values.size} values, "
+                             f"header declares {n}")
+        return starts, lengths, values
+
+    return (split(craw, int(dh["c_runs"]), int(dh["c_n"]), np.uint32),
+            split(draw, int(dh["d_runs"]), int(dh["d_n"]), np.uint16))
+
+
 # ------------------------------------------------- variable-length segments
 
 def pack_vdi_segments(vdi: VDI, n: int, codec: str = "zstd",

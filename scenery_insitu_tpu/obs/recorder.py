@@ -112,6 +112,10 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                           "(deprecation note in the reason)",
     "core.dataset_tf": "unknown dataset name; the generic gray-ramp "
                        "transfer function renders instead of a tuned one",
+    "delta.reuse": "temporal fragment reuse requested where no marched "
+                   "VDI fragment can be carried (gather/hybrid/plain/"
+                   "particle modes, scan blocks); every frame "
+                   "re-marches",
     "head.rank_down": "head node: a render rank went silent past "
                       "stale_frames; frames composite without it "
                       "(degraded flag) until it returns",
@@ -156,6 +160,10 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                     "quarantined (disabled) for the rest of the run",
     "sim.fused_stencil": "fused Pallas stencil unavailable; XLA roll "
                          "formulation advances the sim",
+    "stream.delta_resync": "a temporal-delta P/SKIP record arrived "
+                           "without its base tile retained (an earlier "
+                           "message was lost); dropped while waiting "
+                           "for the next forced I-tile",
     "stream.gap": "VDI stream continuity: a sequence gap, duplicate/"
                   "reordered message, publisher restart, or a tile "
                   "frame abandoned incomplete past the assembler window",

@@ -1,0 +1,403 @@
+"""Temporal-delta VDI streams (docs/PERF.md "Temporal deltas").
+
+Frames of an in-situ run are temporally coherent, yet the pre-delta
+pipeline re-marched, re-encoded and re-published every frame from
+scratch. The reference ships H264 — an inter-frame codec — for exactly
+this reason (SURVEY §2, VideoEncoder); here the same delta principle is
+applied to the VDI representation itself, in two stacked plays:
+
+**P-frame wire codec (host side).** The qpack8 quantizer
+(ops/wire.qpack8_quantize_np) is monotone and deterministic, so two
+frames of the same tile can be compared EXACTLY in code space: equal
+codes + equal [near, far] scale means the dequantized tile is
+bit-identical. Per published tile (the PR-8 column block is the dirty
+unit) `DeltaEncoder` retains the previous frame's code arrays and emits
+one of three records:
+
+- ``SKIP``   codes and scale unchanged — the wire carries only the
+             continuity header (~100 B vs a full compressed tile);
+- ``P``      a sparse residual: runs of changed code slots (start,
+             length) plus the new code values, chosen only when it is
+             smaller than a full tile;
+- ``I``      the full code arrays — the first contact, every
+             ``delta.iframe_period`` frames (forced, so a joining or
+             recovering subscriber is whole again within one period),
+             after a ``reset()`` (scene cut), and whenever a residual
+             would not pay.
+
+`DeltaDecoder` holds the mirrored per-tile state and reconstructs the
+current frame's codes BIT-EXACTLY from (last retained tile + residual).
+Records chain through a per-tile generation counter: a P/SKIP record
+names the generation it patches, so a dropped message simply breaks the
+chain and the decoder answers ``None`` — "wait for the next I-tile" —
+which the subscriber ledgers as ``stream.delta_resync`` (the PR-11
+seq/epoch/CRC machinery is the recovery substrate).
+
+**Dirty-region re-marching (device side).** ``CompositeConfig.
+temporal_reuse = "ranges"`` carries each rank's previous marched VDI
+fragment across frames (`ReuseState`) together with a dirty
+*signature*: the occupancy pyramid's per-(chunk × v-tile) [lo, hi]
+value ranges — already computed every frame since PR 6 — concatenated
+with the camera pose. A rank whose signature moved by at most
+``delta.range_tol`` (and whose camera is bit-unchanged) skips the march
+entirely (`lax.cond` — the matmul waves never issue) and feeds last
+frame's fragment to the unchanged exchange + composite. The detector is
+conservative ON THE SIGNAL: any range motion beyond the tolerance
+re-marches; a field change that preserves every per-brick [lo, hi]
+exactly is invisible to it — that is the contract of a range-based
+detector, and ``range_tol = 0`` with a static camera makes reuse
+bit-exact against recompute for any scene the signature can see.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+DELTA_MODES = ("I", "P", "SKIP")
+
+# wire cost of one changed-slot run: u32 start + u32 length
+_RUN_BYTES = 8
+
+
+# ======================================================================
+# host-side code-space residuals (numpy)
+# ======================================================================
+
+
+def diff_runs(prev: np.ndarray, cur: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Changed-slot runs of ``cur`` against ``prev`` (same shape/dtype,
+    compared flat): returns ``(starts u32[R], lengths u32[R], values[N])``
+    where ``values`` are ``cur``'s codes at the changed slots in flat
+    order (``N == lengths.sum()``). Code arrays compare exactly —
+    integer codes, no epsilon."""
+    if prev.shape != cur.shape or prev.dtype != cur.dtype:
+        raise ValueError(f"delta operands disagree: {prev.shape}/"
+                         f"{prev.dtype} vs {cur.shape}/{cur.dtype}")
+    p, c = prev.ravel(), cur.ravel()
+    changed = p != c
+    idx = np.flatnonzero(changed)
+    if idx.size == 0:
+        return (np.zeros(0, np.uint32), np.zeros(0, np.uint32),
+                np.zeros(0, cur.dtype))
+    brk = np.flatnonzero(np.diff(idx) > 1)
+    starts = idx[np.concatenate([[0], brk + 1])]
+    ends = idx[np.concatenate([brk, [idx.size - 1]])]
+    return (starts.astype(np.uint32),
+            (ends - starts + 1).astype(np.uint32), c[changed])
+
+
+def apply_runs(base: np.ndarray, starts: np.ndarray, lengths: np.ndarray,
+               values: np.ndarray) -> np.ndarray:
+    """Inverse of `diff_runs`: patch ``base`` (flat view of the retained
+    tile) with the residual → the current tile, bit-exact."""
+    out = base.copy().ravel()
+    if starts.size:
+        total = int(lengths.sum())
+        if total != values.size:
+            raise ValueError(f"residual says {total} changed slots but "
+                             f"carries {values.size} values")
+        off = np.cumsum(lengths) - lengths      # value offset of each run
+        idx = (np.repeat(starts.astype(np.int64), lengths)
+               + np.arange(total) - np.repeat(off.astype(np.int64),
+                                              lengths))
+        if idx.size and idx[-1] >= out.size:
+            raise ValueError("residual run exceeds the tile extent")
+        out[idx] = values
+    return out.reshape(base.shape)
+
+
+def runs_wire_bytes(starts: np.ndarray, values: np.ndarray) -> int:
+    """Pre-codec wire bytes of one residual stream: (start, length)
+    pairs plus the changed code values."""
+    return starts.size * _RUN_BYTES + values.size * values.dtype.itemsize
+
+
+class DeltaRecord(NamedTuple):
+    """One encoded tile: what `DeltaEncoder.encode` hands the transport
+    (io/vdi_io.pack_delta_blobs serializes it). ``c_payload``/
+    ``d_payload`` are ``(codes,)`` for I, ``(starts, lengths, values)``
+    for P, ``()`` for SKIP. ``full_bytes``/``wire_bytes`` are pre-codec
+    code bytes — the publish-traffic accounting (compressed sizes are
+    the transport's to report)."""
+
+    mode: str
+    gen: int
+    base_gen: int            # generation this record patches (I: -1)
+    c_payload: tuple
+    d_payload: tuple
+    scale: Tuple[float, float]
+    full_bytes: int
+    wire_bytes: int
+    reason: str              # why this mode ("periodic", "reset", ...)
+
+
+def _full_bytes(ccodes: np.ndarray, dcodes: np.ndarray) -> int:
+    return ccodes.nbytes + dcodes.nbytes
+
+
+class DeltaEncoder:
+    """Publisher-side P-frame state machine: one instance per stream,
+    keyed by tile index (``-1`` for whole-frame publishes). Retains the
+    previous frame's qpack8 code arrays per tile and chooses
+    SKIP / P / I per `encode` call; mints the delta counters
+    (docs/OBSERVABILITY.md): ``delta_tiles_skipped``,
+    ``delta_bytes_saved`` and ``iframe_forced``."""
+
+    def __init__(self, iframe_period: int = 8):
+        if iframe_period < 1:
+            raise ValueError(f"iframe_period must be >= 1, "
+                             f"got {iframe_period}")
+        self.iframe_period = int(iframe_period)
+        # key -> [gen, ccodes, dcodes, (near, far), frames_since_i]
+        self._state = {}
+        self.stats = {"i": 0, "p": 0, "skip": 0, "forced_i": 0,
+                      "bytes_full": 0, "bytes_wire": 0}
+        self._reset_keys = set()
+
+    def reset(self) -> None:
+        """Scene cut: drop all retained tiles — every previously
+        retained tile's next record is a forced I-frame (counted as
+        ``iframe_forced``). Idempotent: a second reset before the next
+        publish must not erase the pending forced-I bookkeeping."""
+        self._reset_keys |= set(self._state)
+        self._state.clear()
+
+    def _mint(self, rec: DeltaRecord) -> DeltaRecord:
+        from scenery_insitu_tpu import obs as _obs
+
+        self.stats["bytes_full"] += rec.full_bytes
+        self.stats["bytes_wire"] += rec.wire_bytes
+        rec_r = _obs.get_recorder()
+        if rec.mode == "SKIP":
+            self.stats["skip"] += 1
+            rec_r.count("delta_tiles_skipped")
+        elif rec.mode == "P":
+            self.stats["p"] += 1
+        else:
+            self.stats["i"] += 1
+            if rec.reason in ("periodic", "reset"):
+                self.stats["forced_i"] += 1
+                rec_r.count("iframe_forced")
+        if rec.wire_bytes < rec.full_bytes:
+            rec_r.count("delta_bytes_saved",
+                        rec.full_bytes - rec.wire_bytes)
+        return rec
+
+    def encode(self, key, ccodes: np.ndarray, dcodes: np.ndarray,
+               near: float, far: float) -> DeltaRecord:
+        """Encode one quantized tile (``ccodes`` u32, ``dcodes`` u16 —
+        the qpack8_quantize_np outputs) against the retained previous
+        tile under ``key``."""
+        full = _full_bytes(ccodes, dcodes)
+        st = self._state.get(key)
+        scale = (float(near), float(far))
+
+        def itile(gen: int, reason: str, first: bool) -> DeltaRecord:
+            # stagger the forced-I phase per tile ON FIRST CONTACT:
+            # tiles of one frame are all first published together, and
+            # lockstep counters would re-ship EVERY tile as a full I in
+            # the same frame every period — a bytes burst ~1/ratio the
+            # steady frame. The one-time per-key offset spreads the
+            # re-ships across the period (the first interval SHORTENS
+            # to period - offset, later ones are the full period, so
+            # the recovery bound holds); whole-frame streams (key -1)
+            # have nothing to stagger against.
+            off = 0
+            if first and isinstance(key, int) and key >= 0:
+                off = key % self.iframe_period
+            self._state[key] = [gen, ccodes.copy(), dcodes.copy(),
+                                scale, off]
+            return self._mint(DeltaRecord(
+                "I", gen, -1, (ccodes,), (dcodes,), scale, full, full,
+                reason))
+
+        if st is None:
+            reason = "reset" if key in self._reset_keys else "first"
+            self._reset_keys.discard(key)
+            return itile(1, reason, first=True)
+        gen, pc, pd, pscale, since_i = st
+        if ccodes.shape != pc.shape or dcodes.shape != pd.shape:
+            # a resized stream (regime change) cannot be patched
+            return itile(gen + 1, "shape_change", first=False)
+        if since_i + 1 >= self.iframe_period:
+            return itile(gen + 1, "periodic", first=False)
+        # one comparison pass: the residual's empty-run case IS the
+        # SKIP decision (a separate array_equal would re-compare the
+        # same elements)
+        cs, cl, cv = diff_runs(pc, ccodes)
+        ds, dl, dv = diff_runs(pd, dcodes)
+        if scale == pscale and cs.size == 0 and ds.size == 0:
+            st[0] = gen + 1
+            st[4] = since_i + 1
+            return self._mint(DeltaRecord(
+                "SKIP", gen + 1, gen, (), (), scale, full, 0, "unchanged"))
+        wire = runs_wire_bytes(cs, cv) + runs_wire_bytes(ds, dv)
+        if wire >= full:
+            return itile(gen + 1, "dense_residual", first=False)
+        self._state[key] = [gen + 1, ccodes.copy(), dcodes.copy(), scale,
+                            since_i + 1]
+        return self._mint(DeltaRecord(
+            "P", gen + 1, gen, (cs, cl, cv), (ds, dl, dv), scale, full,
+            wire, "residual"))
+
+
+class DeltaDecoder:
+    """Subscriber-side mirror of `DeltaEncoder`: retains the last
+    reconstructed code arrays per tile and applies SKIP/P/I records.
+    ``apply`` returns ``None`` when the record's base generation is not
+    the retained one (a dropped message broke the chain) — the caller
+    drops the message and waits for the next I-tile (forced within
+    ``iframe_period`` frames by the encoder)."""
+
+    def __init__(self):
+        self._state = {}     # key -> [gen, ccodes, dcodes, (near, far)]
+        self.stats = {"i": 0, "p": 0, "skip": 0, "resync": 0}
+
+    def reset(self) -> None:
+        """Publisher restart (epoch change): the new encoder shares no
+        state with the old stream — drop everything retained."""
+        self._state.clear()
+
+    def apply(self, key, mode: str, gen: int, base_gen: int,
+              c_payload: tuple, d_payload: tuple,
+              scale: Tuple[float, float]
+              ) -> Optional[Tuple[np.ndarray, np.ndarray, float, float]]:
+        """One record → the reconstructed (ccodes, dcodes, near, far),
+        bit-exact vs the encoder's input, or None when a resync is
+        needed."""
+        if mode == "I":
+            ccodes, dcodes = c_payload[0], d_payload[0]
+            self._state[key] = [gen, ccodes, dcodes, scale]
+            self.stats["i"] += 1
+            return ccodes, dcodes, scale[0], scale[1]
+        st = self._state.get(key)
+        if st is None or st[0] != base_gen:
+            self.stats["resync"] += 1
+            return None
+        if mode == "SKIP":
+            st[0] = gen
+            self.stats["skip"] += 1
+            ccodes, dcodes, scale = st[1], st[2], st[3]
+            return ccodes, dcodes, scale[0], scale[1]
+        if mode != "P":
+            raise ValueError(f"unknown delta mode {mode!r}; "
+                             f"have {DELTA_MODES}")
+        ccodes = apply_runs(st[1], *c_payload)
+        dcodes = apply_runs(st[2], *d_payload)
+        self._state[key] = [gen, ccodes, dcodes, scale]
+        self.stats["p"] += 1
+        return ccodes, dcodes, scale[0], scale[1]
+
+
+# ======================================================================
+# device-side dirty-region re-marching (jax)
+# ======================================================================
+
+
+class ReuseState(NamedTuple):
+    """Per-rank carried state of ``CompositeConfig.temporal_reuse ==
+    "ranges"`` (threaded through the MXU step like the temporal
+    threshold maps). ``sig`` is the dirty signature of the LAST MARCHED
+    frame — occupancy-pyramid [lo, hi] ranges concatenated with the
+    camera pose — so drift under a nonzero ``range_tol`` accumulates
+    against the marched reference instead of creeping. ``color`` /
+    ``depth`` are the rank's last PRE-EXCHANGE marched fragment;
+    ``valid`` is 0 only for the seeded state (first frame always
+    marches); ``dirty`` reports the last frame's decision (host-side
+    counters/events read it — [1] so ranks stack to [n])."""
+
+    sig: Any       # f32[2 * cells + cam]
+    color: Any     # f32[K, 4, nj, ni]
+    depth: Any     # f32[K, 2, nj, ni]
+    valid: Any     # i32[1]
+    dirty: Any     # i32[1]
+
+
+def reuse_signature(pyramid, cam) -> "jnp.ndarray":
+    """Flattened dirty signature: the occupancy pyramid's per-cell
+    [lo, hi] value ranges (the change detector the sim already computes
+    every frame — PR 6) followed by every camera leaf. The ranges OCCUPY
+    the first ``2 * pyramid.lo.size`` slots; `reuse_dirty` applies
+    ``range_tol`` to that prefix only (the camera compares exactly — a
+    moved camera invalidates every fragment)."""
+    import jax
+    import jax.numpy as jnp
+
+    parts = [jnp.ravel(pyramid.lo).astype(jnp.float32),
+             jnp.ravel(pyramid.hi).astype(jnp.float32)]
+    parts += [jnp.ravel(x).astype(jnp.float32)
+              for x in jax.tree_util.tree_leaves(cam)]
+    return jnp.concatenate(parts)
+
+
+def reuse_dirty(sig, prev_sig, valid, range_tol: float, n_ranges: int):
+    """Scalar bool: must this rank re-march? True when the state is the
+    seed (``valid == 0``), when any camera leaf changed bit-for-bit, or
+    when the range prefix moved by more than ``range_tol`` (``0`` =
+    any difference; NaN compares dirty — conservative)."""
+    import jax.numpy as jnp
+
+    cur_r, cur_c = sig[:n_ranges], sig[n_ranges:]
+    prev_r, prev_c = prev_sig[:n_ranges], prev_sig[n_ranges:]
+    if range_tol > 0.0:
+        moved = ~(jnp.max(jnp.abs(cur_r - prev_r)) <= range_tol)
+    else:
+        moved = ~jnp.all(cur_r == prev_r)
+    cam_moved = ~jnp.all(cur_c == prev_c)
+    return (valid[0] == 0) | moved | cam_moved
+
+
+def init_reuse_like(sig, k: int, nj: int, ni: int) -> ReuseState:
+    """Zero-valid seed state shaped for a step whose signature is
+    ``sig`` and whose marched fragments are [k, 4|2, nj, ni] (the seed
+    builder runs this inside shard_map so shapes come out per rank)."""
+    import jax.numpy as jnp
+
+    return ReuseState(
+        sig=jnp.zeros_like(sig),
+        color=jnp.zeros((k, 4, nj, ni), jnp.float32),
+        depth=jnp.zeros((k, 2, nj, ni), jnp.float32),
+        valid=jnp.zeros((1,), jnp.int32),
+        dirty=jnp.zeros((1,), jnp.int32))
+
+
+# ======================================================================
+# traffic model
+# ======================================================================
+
+
+def modeled_delta_traffic(k: int, h: int, w: int, *,
+                          skip_frac: float, p_frac: float = 0.0,
+                          residual_frac: float = 0.1,
+                          iframe_period: int = 8) -> dict:
+    """Steady-state publish bytes/frame of ONE delta stream (k×h×w
+    supersegment slots — per-stream, rank-agnostic) vs qpack8-only
+    (pre-codec code bytes — the same unit `DeltaEncoder` accounts).
+    ``skip_frac``/``p_frac`` are tile fractions in steady state
+    (remainder publishes I); ``residual_frac`` is the changed-slot
+    fraction of a P tile. The forced I every ``iframe_period`` frames
+    re-ships each tile once per period regardless (staggered per tile,
+    so the amortized accounting here is also the per-frame shape)."""
+    if not (0.0 <= skip_frac <= 1.0 and 0.0 <= p_frac <= 1.0
+            and skip_frac + p_frac <= 1.0):
+        raise ValueError("skip_frac/p_frac must be fractions summing "
+                         "to <= 1")
+    full = k * h * w * 6                       # qpack8: 6 B/slot
+    # P cost: values (6 B/slot changed) + run bookkeeping (modeled as
+    # one run per 4 changed slots)
+    p_tile = full * residual_frac * (1.0 + _RUN_BYTES / (6.0 * 4.0))
+    steady = ((1.0 - skip_frac - p_frac) * full + p_frac * p_tile)
+    # amortized forced-I re-ship of the otherwise skipped/P tiles
+    forced = (skip_frac + p_frac) * full / iframe_period
+    per_frame = steady + forced
+    return {
+        "qpack8_bytes_per_frame": full,
+        "delta_bytes_per_frame": per_frame,
+        "reduction_vs_qpack8": (full / per_frame if per_frame else
+                                float("inf")),
+        "skip_frac": skip_frac, "p_frac": p_frac,
+        "residual_frac": residual_frac, "iframe_period": iframe_period,
+    }

@@ -52,6 +52,16 @@ merge order), and the per-wave outputs land in the same W-sharded layout
 — plus the session can deliver finished column blocks to subscribers
 before the frame closes (runtime/session.py tile sinks).
 
+A fourth axis, ``CompositeConfig.temporal_reuse = "ranges"``
+(docs/PERF.md "Temporal deltas"), exploits coherence across FRAMES: the
+MXU step carries each rank's previous marched fragment plus a dirty
+signature (the occupancy pyramid's value ranges + the camera pose —
+ops/delta.py) and skips the march entirely (``lax.cond``) on ranks
+whose signature moved at most ``delta.range_tol``; the exchange +
+composite are unchanged and still run every frame. The carried state
+threads through the step signature exactly like the temporal threshold
+maps (seed with `distributed_initial_reuse_mxu`).
+
 The SIM decomposition is 1-D over the volume z axis with one-voxel halo
 exchange, making distributed trilinear sampling seam-exact vs a
 single-device render (tests assert PSNR, test_parallel.py). The RENDER
@@ -445,6 +455,78 @@ def _resolve_waves(comp_cfg, n: int, width: int, slicer_mod=None) -> bool:
     return True
 
 
+def _resolve_reuse(comp_cfg, supported: bool = True,
+                   where: str = "") -> bool:
+    """Build-time resolution of CompositeConfig.temporal_reuse for a
+    step builder (docs/PERF.md "Temporal deltas"): True = thread the
+    carried ReuseState through the step signature. Builders with no
+    marched VDI fragment to carry (gather engine, hybrid, plain) ledger
+    the configured-but-inert knob instead of silently ignoring it."""
+    if comp_cfg is None or comp_cfg.temporal_reuse != "ranges":
+        return False
+    if not supported:
+        from scenery_insitu_tpu import obs as _obs
+
+        _obs.degrade("delta.reuse", "ranges", "off",
+                     f"{where} carries no reusable VDI fragment "
+                     "(temporal_reuse is an MXU VDI step feature)",
+                     warn=False)
+        return False
+    from scenery_insitu_tpu import obs as _obs
+
+    rec = _obs.get_recorder()
+    rec.count("reuse_steps_built")
+    return True
+
+
+def _reuse_state_spec(axis):
+    """Sharding spec of the distributed ReuseState: per-rank leaves
+    stack along their leading axis (the thr-state convention) — sig [S]
+    → [n*S], fragments [K, ...] → [n*K, ...], valid/dirty [1] → [n]."""
+    from scenery_insitu_tpu.ops.delta import ReuseState
+
+    return ReuseState(sig=P(axis), color=P(axis, None, None, None),
+                      depth=P(axis, None, None, None),
+                      valid=P(axis), dirty=P(axis))
+
+
+def distributed_initial_reuse_mxu(mesh: Mesh, tf: TransferFunction,
+                                  spec,
+                                  vdi_cfg: Optional[VDIConfig] = None,
+                                  comp_cfg: Optional[CompositeConfig]
+                                  = None,
+                                  axis_name: Optional[str] = None,
+                                  plan=None):
+    """Jitted seeder for ``temporal_reuse = "ranges"`` steps: returns
+    ``f(vol_data (z-sharded), origin, spacing, cam) -> ReuseState`` with
+    ``valid = 0`` everywhere, so the first real frame marches and fills
+    the carry (the `distributed_initial_threshold_mxu` pattern). The
+    per-rank signature length comes out of the same frame-state prelude
+    the step runs, so the shapes can never disagree."""
+    from scenery_insitu_tpu.ops import delta as _delta
+
+    vdi_cfg = vdi_cfg or VDIConfig()
+    comp_cfg = comp_cfg or CompositeConfig()
+    axis = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    plan = _resolve_plan(comp_cfg, n, plan)
+
+    def seed(local_data, origin, spacing, cam: Camera):
+        # comp_cfg=None: the seed needs only the pyramid's SHAPE — no
+        # K-budget psum, no budget ledger rows
+        _, _, _, _, _, pyr, _ = _rank_frame_state(
+            local_data, origin, spacing, spec, tf, vdi_cfg, axis, n,
+            None, plan=plan, need_pyramid=True)
+        sig = _delta.reuse_signature(pyr, cam)
+        return _delta.init_reuse_like(sig, vdi_cfg.max_supersegments,
+                                      spec.nj, spec.ni)
+
+    f = shard_map(seed, mesh=mesh,
+                  in_specs=(P(axis, None, None), P(), P(), P()),
+                  out_specs=_reuse_state_spec(axis), check_vma=False)
+    return jax.jit(f)
+
+
 def _rebalance_build_marker(plan, n: int) -> None:
     """Host-side trace-time marker of one rebalanced-step build
     (docs/OBSERVABILITY.md): counts the build and records the plan's
@@ -630,6 +712,8 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
         _obs.degrade("occupancy.k_budget", "occupancy", "static",
                      "gather-engine distributed step has no occupancy "
                      "pyramid (mxu builders only)", warn=False)
+    _resolve_reuse(comp_cfg, supported=False,
+                   where="the gather-engine distributed step")
     plan = _resolve_plan(comp_cfg, n, plan)
 
     def step(local_data, origin, spacing, cam: Camera) -> VDI:
@@ -795,14 +879,16 @@ def _planned_slab(local_data, origin, spacing, spec, axis, n,
 
 
 def _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
-                      axis, n, comp_cfg, plan=None):
+                      axis, n, comp_cfg, plan=None,
+                      need_pyramid: bool = False):
     """Per-frame, per-rank shared state of an MXU generation: the
     halo-exact slab (or planned render band, ``plan``), the frame's ONE
     occupancy pyramid, and (when ``comp_cfg.k_budget == "occupancy"``)
     the psum-derived adaptive-K target. Shared by the frame-schedule
     generation (`_mxu_rank_generate`) and the tile-wave path
     (`_mxu_rank_generate_waves`) — T waves must not pay T pyramids or T
-    psums."""
+    psums. ``need_pyramid`` forces the pyramid even with skipping off —
+    the temporal-reuse dirty detector reads its ranges every frame."""
     vol, gmax, v_bounds, w_bounds, dims = _rank_slab(
         local_data, origin, spacing, spec, axis, n, plan=plan)
     occ_pyr = None
@@ -817,7 +903,7 @@ def _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
                      "k budgets re-target the ADAPTIVE threshold; "
                      "vdi.adaptive=False ignores them", warn=False)
         budgeted = False
-    if spec.skip_empty or budgeted:
+    if spec.skip_empty or budgeted or need_pyramid:
         from scenery_insitu_tpu.ops import occupancy as _occ
 
         occ_pyr = _occ.pyramid_from_volume(vol, tf, spec)
@@ -839,11 +925,12 @@ def _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
 
 def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
                        tf, vdi_cfg, axis, n, threshold=None,
-                       comp_cfg=None, plan=None):
+                       comp_cfg=None, plan=None, reuse=None,
+                       reuse_tol: float = 0.0):
     """Per-rank slice-march VDI generation on a z-slab (shared by the
     distributed VDI and hybrid steps). Returns (vdi, meta, axcam,
-    next_threshold) — the last is None unless carried temporal threshold
-    state was passed in.
+    next_threshold, next_reuse) — the last two are None unless carried
+    temporal threshold / reuse state was passed in.
 
     This is where the frame's ONE occupancy pyramid is built
     (ops/occupancy.pyramid_from_volume on the halo-exact slab) and
@@ -853,29 +940,83 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
     ``comp_cfg.k_budget == "occupancy"``: a psum over the mesh turns the
     per-rank live fractions into shares of the N*K budget
     (occupancy.k_budget_target), so the adaptive threshold on a sparse
-    slab stops chasing the same K as the densest rank."""
+    slab stops chasing the same K as the densest rank.
+
+    ``reuse`` (an ops/delta.ReuseState; docs/PERF.md "Temporal deltas")
+    carries the previous frame's marched fragment plus its dirty
+    signature: when the pyramid's ranges moved at most ``reuse_tol`` and
+    the camera is bit-unchanged, the march is skipped under ``lax.cond``
+    (no matmul wave issues — both branches are collective-free, so a
+    per-rank divergent predicate is sound inside shard_map) and the
+    carried fragment feeds the unchanged exchange + composite."""
     vol, gmax, v_bounds, w_bounds, dims, occ_pyr, k_target = \
         _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
-                          axis, n, comp_cfg, plan=plan)
-    if threshold is None:
-        vdi, meta, axcam = slicer.generate_vdi_mxu(
-            vol, tf, cam, spec, vdi_cfg,
-            box_min=origin, box_max=gmax, v_bounds=v_bounds,
-            occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
-        thr2 = None
-    else:
-        vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
-            vol, tf, cam, spec, threshold, vdi_cfg,
-            box_min=origin, box_max=gmax, v_bounds=v_bounds,
-            occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
-    # metadata must describe the GLOBAL volume, not this rank's slab
+                          axis, n, comp_cfg, plan=plan,
+                          need_pyramid=reuse is not None)
+    if reuse is None:
+        if threshold is None:
+            vdi, meta, axcam = slicer.generate_vdi_mxu(
+                vol, tf, cam, spec, vdi_cfg,
+                box_min=origin, box_max=gmax, v_bounds=v_bounds,
+                occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
+            thr2 = None
+        else:
+            vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
+                vol, tf, cam, spec, threshold, vdi_cfg,
+                box_min=origin, box_max=gmax, v_bounds=v_bounds,
+                occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
+        # metadata must describe the GLOBAL volume, not this rank's slab
+        meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
+        return vdi, meta, axcam, thr2, None
+
+    from scenery_insitu_tpu.ops import delta as _delta
+
+    axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
+                                    box_max=gmax)
+    sig = _delta.reuse_signature(occ_pyr, cam)
+    dirty = _delta.reuse_dirty(sig, reuse.sig, reuse.valid, reuse_tol,
+                               2 * occ_pyr.lo.size)
+
+    def marched(_):
+        if threshold is None:
+            vdi, _, _ = slicer.generate_vdi_mxu(
+                vol, tf, cam, spec, vdi_cfg, v_bounds=v_bounds,
+                occupancy=occ_pyr, k_target=k_target, axcam=axcam,
+                w_bounds=w_bounds)
+            return vdi.color, vdi.depth
+        vdi, _, _, thr2 = slicer.generate_vdi_mxu_temporal(
+            vol, tf, cam, spec, threshold, vdi_cfg, v_bounds=v_bounds,
+            occupancy=occ_pyr, k_target=k_target, axcam=axcam,
+            w_bounds=w_bounds)
+        return vdi.color, vdi.depth, thr2
+
+    def kept(_):
+        # a clean rank: last frame's fragment IS this frame's (the
+        # temporal threshold controller holds too — nothing marched, so
+        # there is no observation to feed it)
+        if threshold is None:
+            return reuse.color, reuse.depth
+        return reuse.color, reuse.depth, threshold
+
+    out = jax.lax.cond(dirty, marched, kept, None)
+    color, depth = out[0], out[1]
+    thr2 = out[2] if threshold is not None else None
+    reuse2 = _delta.ReuseState(
+        # the signature tracks the last MARCHED frame, so sub-tolerance
+        # drift accumulates instead of creeping away unseen
+        sig=jnp.where(dirty, sig, reuse.sig),
+        color=color, depth=depth,
+        valid=jnp.ones_like(reuse.valid),
+        dirty=dirty.astype(jnp.int32).reshape(1))
+    meta = slicer._vdi_meta(vol, axcam, spec.ni, spec.nj, 0)
     meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
-    return vdi, meta, axcam, thr2
+    return VDI(color, depth), meta, axcam, thr2, reuse2
 
 
 def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
                              spec, tf, vdi_cfg, comp_cfg, axis, n,
-                             threshold=None, plan=None):
+                             threshold=None, plan=None, reuse=None,
+                             reuse_tol: float = 0.0):
     """The tile-wave twin of `_mxu_rank_generate` + `_composite_exchanged`
     (CompositeConfig.schedule == "waves"; docs/PERF.md "Tile waves"):
     instead of one whole-frame march followed by one exchange, each rank
@@ -891,13 +1032,19 @@ def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
     columns and scatters the controller's update back — the full-frame
     state that crosses frames is bit-identical in meaning to the frame
     schedule's (each pixel is marched exactly once per frame either
-    way). Returns (vdi [K_out over this rank's contiguous column
-    block], meta, axcam, thr')."""
+    way). ``reuse`` (docs/PERF.md "Temporal deltas") works like
+    `_mxu_rank_generate`'s: the dirty predicate is per rank (the range
+    signature is rank-wide) and every wave of a clean rank skips its
+    march under ``lax.cond`` — the wave slice of the carried full-frame
+    fragment stands in, so the waves' exchange + composite overlap
+    pipeline is untouched. Returns (vdi [K_out over this rank's
+    contiguous column block], meta, axcam, thr', reuse')."""
     import jax.tree_util as jtu
 
     vol, gmax, v_bounds, w_bounds, dims, occ_pyr, k_target = \
         _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
-                          axis, n, comp_cfg, plan=plan)
+                          axis, n, comp_cfg, plan=plan,
+                          need_pyramid=reuse is not None)
     t = comp_cfg.wave_tiles
     slicer.wave_block(spec.ni, n, t)       # validates the geometry
     axcam = slicer.make_axis_camera(vol, cam, spec, box_min=origin,
@@ -907,42 +1054,89 @@ def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
                        comp_cfg.max_output_supersegments,
                        comp_cfg.exchange, comp_cfg.ring_slots,
                        comp_cfg.wire, marched=True)
+    if reuse is not None:
+        from scenery_insitu_tpu.ops import delta as _delta
 
-    def march_wave(w, thr_full):
+        sig = _delta.reuse_signature(occ_pyr, cam)
+        dirty = _delta.reuse_dirty(sig, reuse.sig, reuse.valid,
+                                   reuse_tol, 2 * occ_pyr.lo.size)
+
+    def march_wave(w, carry):
+        if reuse is not None:
+            thr_full, acc_c, acc_d = carry
+        else:
+            thr_full = carry
         axcam_w, spec_w = slicer.wave_camera(axcam, spec, n, t, w)
-        if thr_full is None:
-            vdi, _, _ = slicer.generate_vdi_mxu(
-                vol, tf, cam, spec_w, vdi_cfg, v_bounds=v_bounds,
+        thr_w = (None if thr_full is None else
+                 jtu.tree_map(lambda m: slicer.wave_cols(m, n, t, w),
+                              thr_full))
+
+        def marched(_):
+            if thr_w is None:
+                vdi, _, _ = slicer.generate_vdi_mxu(
+                    vol, tf, cam, spec_w, vdi_cfg, v_bounds=v_bounds,
+                    occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
+                    volp=volp, w_bounds=w_bounds)
+                return vdi.color, vdi.depth
+            vdi, _, _, thr2w = slicer.generate_vdi_mxu_temporal(
+                vol, tf, cam, spec_w, thr_w, vdi_cfg, v_bounds=v_bounds,
                 occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
                 volp=volp, w_bounds=w_bounds)
-            return (vdi.color, vdi.depth), None
-        thr_w = jtu.tree_map(lambda m: slicer.wave_cols(m, n, t, w),
-                             thr_full)
-        vdi, _, _, thr2w = slicer.generate_vdi_mxu_temporal(
-            vol, tf, cam, spec_w, thr_w, vdi_cfg, v_bounds=v_bounds,
-            occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
-            volp=volp, w_bounds=w_bounds)
-        thr_full = jtu.tree_map(
-            lambda m, mw: slicer.wave_update_cols(m, mw, n, t, w),
-            thr_full, thr2w)
-        return (vdi.color, vdi.depth), thr_full
+            return vdi.color, vdi.depth, thr2w
+
+        if reuse is None:
+            out = marched(None)
+        else:
+            def kept(_):
+                cw = slicer.wave_cols(acc_c, n, t, w)
+                dw = slicer.wave_cols(acc_d, n, t, w)
+                if thr_w is None:
+                    return cw, dw
+                return cw, dw, thr_w
+
+            out = jax.lax.cond(dirty, marched, kept, None)
+        cw, dw = out[0], out[1]
+        if thr_full is not None:
+            thr_full = jtu.tree_map(
+                lambda m, mw: slicer.wave_update_cols(m, mw, n, t, w),
+                thr_full, out[2])
+        if reuse is None:
+            return (cw, dw), thr_full
+        # the carried full-frame fragment accumulates wave by wave; a
+        # clean rank scatters back exactly what it sliced out (no-op)
+        acc_c = slicer.wave_update_cols(acc_c, cw, n, t, w)
+        acc_d = slicer.wave_update_cols(acc_d, dw, n, t, w)
+        return (cw, dw), (thr_full, acc_c, acc_d)
 
     def compose(fr):
         out = _composite_exchanged(fr[0], fr[1], n, axis, comp_cfg)
         return out.color, out.depth
 
-    (oc, od), thr2 = _wave_pipeline(t, march_wave, compose, threshold)
+    carry0 = (threshold if reuse is None else
+              (threshold, reuse.color, reuse.depth))
+    (oc, od), carry = _wave_pipeline(t, march_wave, compose, carry0)
+    if reuse is None:
+        thr2, reuse2 = carry, None
+    else:
+        from scenery_insitu_tpu.ops import delta as _delta
+
+        thr2, acc_c, acc_d = carry
+        reuse2 = _delta.ReuseState(
+            sig=jnp.where(dirty, sig, reuse.sig),
+            color=acc_c, depth=acc_d,
+            valid=jnp.ones_like(reuse.valid),
+            dirty=dirty.astype(jnp.int32).reshape(1))
     vdi = VDI(_wave_assemble(oc), _wave_assemble(od))
     meta = slicer._vdi_meta(vol, axcam, spec.ni, spec.nj, 0)
     meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
-    return vdi, meta, axcam, thr2
+    return vdi, meta, axcam, thr2, reuse2
 
 
 def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
                              spec, vdi_cfg: Optional[VDIConfig] = None,
                              comp_cfg: Optional[CompositeConfig] = None,
                              axis_name: Optional[str] = None,
-                             plan=None):
+                             plan=None, reuse_tol: float = 0.0):
     """Distributed sort-last VDI pipeline on the MXU slice-march engine
     (ops/slicer.py) — generation runs as banded-matmul slice resampling
     instead of per-ray gathers; the rest of the chain (width-axis column
@@ -957,16 +1151,30 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
     Domain decomposition is the same z-slab sharding as
     `distributed_vdi_step`; ownership of in-plane samples is half-open per
     rank, halo rows make boundary interpolation seam-exact.
+
+    ``comp_cfg.temporal_reuse == "ranges"`` changes the signature to
+    ``f(vol_data, origin, spacing, cam, reuse) -> ((VDI, meta),
+    reuse')`` — seed ``reuse`` with `distributed_initial_reuse_mxu`;
+    ``reuse_tol`` is the dirty tolerance (cfg.delta.range_tol).
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                           temporal=False, plan=plan)
+                           temporal=False, plan=plan,
+                           reuse_tol=reuse_tol)
 
 
 def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                    temporal: bool, plan=None):
+                    temporal: bool, plan=None, reuse_tol: float = 0.0):
     """Shared builder of the MXU sort-last step (generate → column
     exchange under ``comp_cfg.exchange`` → composite), with or without
-    carried temporal threshold state threaded through."""
+    carried temporal threshold state threaded through.
+
+    ``comp_cfg.temporal_reuse == "ranges"`` (docs/PERF.md "Temporal
+    deltas") appends a second carry: the step signature gains a trailing
+    ``reuse`` argument (an ops/delta.ReuseState from
+    `distributed_initial_reuse_mxu`) and the return gains ``reuse'`` —
+    ranks whose occupancy-range signature moved at most ``reuse_tol``
+    (``FrameworkConfig.delta.range_tol``) skip their march and feed the
+    carried fragment to the exchange."""
     from scenery_insitu_tpu.core.vdi import VDIMetadata
     from scenery_insitu_tpu.ops import slicer
 
@@ -979,40 +1187,68 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
                          f"mesh size {n}")
     waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
     plan = _resolve_plan(comp_cfg, n, plan)
+    reuse = _resolve_reuse(comp_cfg)
 
-    def body(local_data, origin, spacing, cam, thr):
+    def body(local_data, origin, spacing, cam, thr, ru):
         if waves:
-            out, meta, _, thr2 = _mxu_rank_generate_waves(
+            out, meta, _, thr2, ru2 = _mxu_rank_generate_waves(
                 local_data, origin, spacing, cam, slicer, spec, tf,
-                vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan)
-            return out, meta, thr2
-        vdi, meta, _, thr2 = _mxu_rank_generate(local_data, origin,
-                                                spacing, cam, slicer, spec,
-                                                tf, vdi_cfg, axis, n,
-                                                threshold=thr,
-                                                comp_cfg=comp_cfg,
-                                                plan=plan)
+                vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan,
+                reuse=ru, reuse_tol=reuse_tol)
+            return out, meta, thr2, ru2
+        vdi, meta, _, thr2, ru2 = _mxu_rank_generate(
+            local_data, origin, spacing, cam, slicer, spec, tf, vdi_cfg,
+            axis, n, threshold=thr, comp_cfg=comp_cfg, plan=plan,
+            reuse=ru, reuse_tol=reuse_tol)
         return (_composite_exchanged(vdi.color, vdi.depth, n, axis,
-                                     comp_cfg), meta, thr2)
+                                     comp_cfg), meta, thr2, ru2)
 
     spec_vol = P(axis, None, None)
     out_vdi = VDI(P(None, None, None, axis), P(None, None, None, axis))
     out_meta = VDIMetadata(*(P() for _ in VDIMetadata._fields))
 
-    if temporal:
+    if temporal and reuse:
+        thr_spec = _thr_state_spec(axis)
+        ru_spec = _reuse_state_spec(axis)
+
+        def step(local_data, origin, spacing, cam: Camera, thr, ru):
+            out, meta, thr2, ru2 = body(local_data, origin, spacing,
+                                        cam, thr, ru)
+            return (out, meta), thr2, ru2
+
+        f = shard_map(step, mesh=mesh,
+                      in_specs=(spec_vol, P(), P(), P(), thr_spec,
+                                ru_spec),
+                      out_specs=((out_vdi, out_meta), thr_spec, ru_spec),
+                      check_vma=False)
+    elif temporal:
         thr_spec = _thr_state_spec(axis)
 
         def step(local_data, origin, spacing, cam: Camera, thr):
-            out, meta, thr2 = body(local_data, origin, spacing, cam, thr)
+            out, meta, thr2, _ = body(local_data, origin, spacing, cam,
+                                      thr, None)
             return (out, meta), thr2
 
         f = shard_map(step, mesh=mesh,
                       in_specs=(spec_vol, P(), P(), P(), thr_spec),
                       out_specs=((out_vdi, out_meta), thr_spec),
                       check_vma=False)
+    elif reuse:
+        ru_spec = _reuse_state_spec(axis)
+
+        def step(local_data, origin, spacing, cam: Camera, ru):
+            out, meta, _, ru2 = body(local_data, origin, spacing, cam,
+                                     None, ru)
+            return (out, meta), ru2
+
+        f = shard_map(step, mesh=mesh,
+                      in_specs=(spec_vol, P(), P(), P(), ru_spec),
+                      out_specs=((out_vdi, out_meta), ru_spec),
+                      check_vma=False)
     else:
         def step(local_data, origin, spacing, cam: Camera):
-            out, meta, _ = body(local_data, origin, spacing, cam, None)
+            out, meta, _, _ = body(local_data, origin, spacing, cam,
+                                   None, None)
             return out, meta
 
         f = shard_map(step, mesh=mesh,
@@ -1070,7 +1306,7 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
                                       comp_cfg: Optional[CompositeConfig]
                                       = None,
                                       axis_name: Optional[str] = None,
-                                      plan=None):
+                                      plan=None, reuse_tol: float = 0.0):
     """`distributed_vdi_step_mxu` with carried per-rank temporal threshold
     state (adaptive_mode="temporal": ONE march per rank per frame instead
     of counting + write — see slicer.generate_vdi_mxu_temporal).
@@ -1079,10 +1315,12 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
     ((VDI, meta), thr')`` where thr is the rank-sharded ThresholdState
     from `distributed_initial_threshold_mxu`. Each rank adapts the
     threshold map of its own generation camera footprint; the sort-last
-    exchange and composite are unchanged.
+    exchange and composite are unchanged. With ``comp_cfg.temporal_reuse
+    == "ranges"`` the signature gains a trailing ``reuse`` carry and
+    return (see `distributed_vdi_step_mxu`).
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                           temporal=True, plan=plan)
+                           temporal=True, plan=plan, reuse_tol=reuse_tol)
 
 
 def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
@@ -1127,6 +1365,9 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
                          f"mesh size {n}")
     waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
     plan = _resolve_plan(comp_cfg, n, plan)
+    # the hybrid frame re-splats particles every frame anyway; carrying
+    # the VDI half's fragments is future work — say so, don't ignore
+    _resolve_reuse(comp_cfg, supported=False, where="the hybrid step")
 
     def body(local_data, origin, spacing, tr_pos, tr_vel, cam, thr):
         if waves:
@@ -1134,11 +1375,11 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
             # is per-frame (particles are sort-first, exchange-free) and
             # inserts into the ASSEMBLED contiguous column block — the
             # same block the frame schedule composites
-            comp, meta, axcam, thr2 = _mxu_rank_generate_waves(
+            comp, meta, axcam, thr2, _ = _mxu_rank_generate_waves(
                 local_data, origin, spacing, cam, slicer, spec, tf,
                 vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan)
         else:
-            vdi, meta, axcam, thr2 = _mxu_rank_generate(
+            vdi, meta, axcam, thr2, _ = _mxu_rank_generate(
                 local_data, origin, spacing, cam, slicer, spec, tf,
                 vdi_cfg, axis, n, threshold=thr, comp_cfg=comp_cfg,
                 plan=plan)
@@ -1199,6 +1440,7 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                rebalance_hysteresis: float = 0.25,
                                rebalance_min_depth: int = 4,
                                rebalance_quantum: int = 4,
+                               temporal_reuse: str = "off",
                                plan=None):
     """Distributed plain-image rendering on the MXU slice-march engine —
     the TPU-fast counterpart of `distributed_plain_step` (the reference's
@@ -1248,12 +1490,15 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                rebalance_period=rebalance_period,
                                rebalance_hysteresis=rebalance_hysteresis,
                                rebalance_min_depth=rebalance_min_depth,
-                               rebalance_quantum=rebalance_quantum)
+                               rebalance_quantum=rebalance_quantum,
+                               temporal_reuse=temporal_reuse)
     waves = _resolve_waves(knob_cfg, n, spec.ni, slicer)
     # a planned band must be at least as deep as the AO shade halo
     plan = _resolve_plan(knob_cfg, n, plan,
                          min_halo=(cfg.ao_radius + 1
                                    if cfg.ao_strength > 0.0 else 1))
+    _resolve_reuse(knob_cfg, supported=False,
+                   where="the plain-image MXU step")
 
     # distributed AO: pre-shade each rank's slab with TF + occlusion on a
     # radius-deep halo (seam-exact — see _rank_slab's shade hook), then
@@ -1334,6 +1579,7 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            rebalance_hysteresis: float = 0.25,
                            rebalance_min_depth: int = 4,
                            rebalance_quantum: int = 4,
+                           temporal_reuse: str = "off",
                            plan=None):
     """Build the jitted distributed plain-image render step (the reference's
     non-VDI mode: VolumeRaycaster + PlainImageCompositor,
@@ -1354,11 +1600,14 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                                rebalance_period=rebalance_period,
                                rebalance_hysteresis=rebalance_hysteresis,
                                rebalance_min_depth=rebalance_min_depth,
-                               rebalance_quantum=rebalance_quantum)
+                               rebalance_quantum=rebalance_quantum,
+                               temporal_reuse=temporal_reuse)
     waves = _resolve_waves(knob_cfg, n, width)
     plan = _resolve_plan(knob_cfg, n, plan,
                          min_halo=(cfg.ao_radius + 1
                                    if cfg.ao_strength > 0.0 else 1))
+    _resolve_reuse(knob_cfg, supported=False,
+                   where="the plain-image gather step")
 
     # rank partials must stay background-free — the background is blended
     # exactly once, by the final composite (blending it per rank would

@@ -333,6 +333,7 @@ class InSituSession:
         self.obs.count("build_steps")
         self._mxu_steps = {}   # regime key -> jitted distributed step
         self._mxu_thr = {}     # regime key -> temporal threshold state
+        self._mxu_reuse = {}   # regime key -> temporal-reuse ReuseState
         self._scan_steps = {}  # (kind, regime, block) -> scan executable
         self._profile_fn = None  # jitted z-live-profile fetch (replan)
         self.mode = "vdi"
@@ -379,12 +380,26 @@ class InSituSession:
                 rebalance_hysteresis=cc.rebalance_hysteresis,
                 rebalance_min_depth=cc.rebalance_min_depth,
                 rebalance_quantum=cc.rebalance_quantum,
+                temporal_reuse=cc.temporal_reuse,
                 plan=self._plan)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
                           and self.mode in ("vdi", "hybrid")
                           and self.engine == "mxu")
+        # temporal fragment reuse (docs/PERF.md "Temporal deltas"): the
+        # carried-state plumbing exists on the MXU VDI step only; other
+        # modes' builders (gather/hybrid/plain) ledger the knob inert,
+        # and the particle step never consults CompositeConfig at all —
+        # say so here rather than silently rendering every frame
+        self._reuse = (self.cfg.composite.temporal_reuse == "ranges"
+                       and self.mode == "vdi" and self.engine == "mxu"
+                       and self._step is None)
+        if self.cfg.composite.temporal_reuse == "ranges" \
+                and not self._reuse and self.mode == "particles":
+            _obs.degrade("delta.reuse", "ranges", "off",
+                         "particle sessions march no volume fragments",
+                         warn=False)
         # particle/plain modes never consult cfg.vdi — only reject the
         # mode that would hit the slicer's temporal-needs-state error at
         # trace time (gather VDI generation)
@@ -649,7 +664,36 @@ class InSituSession:
     def _enter_regime(self, key) -> None:
         if key != getattr(self, "_last_regime_key", key):
             self.obs.count("regime_switches")
+            # carried reuse fragments share the temporal-threshold
+            # staleness policy: the field kept evolving while the
+            # camera was in another regime, and a re-entered regime's
+            # retained signature could mask that (the camera leaves
+            # match again) — re-seed instead
+            self._mxu_reuse.pop(key, None)
         drop_on_regime_reentry(self, self._mxu_thr, key)
+
+    def _note_dirty(self, ru) -> None:
+        """Host-side accounting of the reuse carry's LAST decision
+        (docs/OBSERVABILITY.md): ``delta_march_skipped`` counts tiles
+        whose march never issued, and the per-frame dirty histogram
+        event carries the per-rank bits. Reads the INCOMING carry — the
+        decision it describes is the previous frame's, which has
+        already executed (no extra sync on the in-flight dispatch)."""
+        d = np.asarray(ru.dirty)
+        if not np.asarray(ru.valid).any():
+            return                       # seed state: nothing decided yet
+        cc = self.cfg.composite
+        n = d.size
+        tiles_per_rank = (cc.wave_tiles
+                          if cc.schedule == "waves" and n > 1 else 1)
+        clean = int((d == 0).sum())
+        if clean:
+            self.obs.count("delta_march_skipped", clean * tiles_per_rank)
+        self.obs.event("delta_dirty_tiles", frame=self.frame_index - 1,
+                       dirty=[int(x) for x in d],
+                       tiles_per_rank=tiles_per_rank,
+                       skipped_tiles=clean * tiles_per_rank,
+                       total_tiles=n * tiles_per_rank)
 
     # ------------------------------------------------- frame-scan blocks
 
@@ -682,6 +726,19 @@ class InSituSession:
             self.obs.event("compile", frame=self.frame_index,
                            what="scan_block", regime=str(regime),
                            block=block)
+            comp_cfg = self.cfg.composite
+            if self._reuse:
+                # the scan body does not thread the reuse carry — a
+                # scanned block re-marches every frame (the scan's
+                # whole point is zero host round trips per frame, which
+                # is also what the host-held carry would need)
+                import dataclasses as _dc
+
+                comp_cfg = _dc.replace(comp_cfg, temporal_reuse="off")
+                _obs.degrade("delta.reuse", "ranges", "off",
+                             "scan blocks do not thread the reuse "
+                             "carry; scanned frames re-march",
+                             warn=False)
             if regime is None:
                 step, seed = self._step, None
             else:
@@ -692,14 +749,14 @@ class InSituSession:
                 if self._temporal:
                     step = distributed_vdi_step_mxu_temporal(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        self.cfg.composite, plan=self._plan)
+                        comp_cfg, plan=self._plan)
                     seed = distributed_initial_threshold_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
                         plan=self._plan)
                 else:
                     step = distributed_vdi_step_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        self.cfg.composite, plan=self._plan)
+                        comp_cfg, plan=self._plan)
                     seed = None
             steps_per_frame = self.cfg.sim.steps_per_frame
             mesh_n = self.mesh.shape[self.cfg.mesh.axis_name]
@@ -878,6 +935,7 @@ class InSituSession:
             regimes = [(a, s) for a in (0, 1, 2) for s in (1, -1)]
         cam0 = self.camera
         thr0 = dict(self._mxu_thr)
+        reuse0 = dict(self._mxu_reuse)
         had_last = hasattr(self, "_last_regime_key")
         last0 = getattr(self, "_last_regime_key", None)
         times = {}
@@ -902,6 +960,7 @@ class InSituSession:
         finally:
             self.camera = cam0
             self._mxu_thr = thr0
+            self._mxu_reuse = reuse0
             if had_last:
                 self._last_regime_key = last0
             elif hasattr(self, "_last_regime_key"):
@@ -1001,6 +1060,7 @@ class InSituSession:
                 rebalance_hysteresis=cc.rebalance_hysteresis,
                 rebalance_min_depth=cc.rebalance_min_depth,
                 rebalance_quantum=cc.rebalance_quantum,
+                temporal_reuse=cc.temporal_reuse,
                 plan=self._plan)
             r = self.cfg.render
             slicer = self._slicer
@@ -1023,11 +1083,12 @@ class InSituSession:
         returned callable seeds and threads the per-regime threshold
         state internally, so callers see the same 4-arg signature."""
         from scenery_insitu_tpu.parallel.pipeline import (
+            distributed_initial_reuse_mxu,
             distributed_initial_threshold_mxu, distributed_vdi_step_mxu,
             distributed_vdi_step_mxu_temporal)
 
         regime = self._slicer.choose_axis(self.camera)
-        if self._temporal:
+        if self._temporal or self._reuse:
             self._enter_regime(regime)
         step = self._mxu_steps.get(regime)
         if step is None:
@@ -1038,21 +1099,52 @@ class InSituSession:
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
+            tol = self.cfg.delta.range_tol
+            rseed = (distributed_initial_reuse_mxu(
+                         self.mesh, self.tf, spec, self.cfg.vdi,
+                         self.cfg.composite, plan=self._plan)
+                     if self._reuse else None)
             if self._temporal:
                 inner = distributed_vdi_step_mxu_temporal(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    self.cfg.composite, plan=self._plan)
+                    self.cfg.composite, plan=self._plan, reuse_tol=tol)
                 seed = distributed_initial_threshold_mxu(
                     self.mesh, self.tf, spec, self.cfg.vdi,
                     plan=self._plan)
 
                 def step(field, origin, spacing, cam,
-                         _regime=regime, _inner=inner, _seed=seed):
+                         _regime=regime, _inner=inner, _seed=seed,
+                         _rseed=rseed):
                     thr = self._mxu_thr.get(_regime)
                     if thr is None:
                         thr = _seed(field, origin, spacing, cam)
-                    out, self._mxu_thr[_regime] = _inner(
-                        field, origin, spacing, cam, thr)
+                    if _rseed is None:
+                        out, self._mxu_thr[_regime] = _inner(
+                            field, origin, spacing, cam, thr)
+                        return out
+                    ru = self._mxu_reuse.get(_regime)
+                    if ru is None:
+                        ru = _rseed(field, origin, spacing, cam)
+                    if getattr(self.obs, "enabled", False):
+                        self._note_dirty(ru)
+                    out, self._mxu_thr[_regime], \
+                        self._mxu_reuse[_regime] = _inner(
+                            field, origin, spacing, cam, thr, ru)
+                    return out
+            elif self._reuse:
+                inner = distributed_vdi_step_mxu(
+                    self.mesh, self.tf, spec, self.cfg.vdi,
+                    self.cfg.composite, plan=self._plan, reuse_tol=tol)
+
+                def step(field, origin, spacing, cam,
+                         _regime=regime, _inner=inner, _rseed=rseed):
+                    ru = self._mxu_reuse.get(_regime)
+                    if ru is None:
+                        ru = _rseed(field, origin, spacing, cam)
+                    if getattr(self.obs, "enabled", False):
+                        self._note_dirty(ru)
+                    out, self._mxu_reuse[_regime] = _inner(
+                        field, origin, spacing, cam, ru)
                     return out
             else:
                 step = distributed_vdi_step_mxu(

@@ -47,7 +47,7 @@ from typing import Callable, Iterator, Optional, Tuple, Union
 import numpy as np
 
 from scenery_insitu_tpu import obs as _obs
-from scenery_insitu_tpu.config import FaultConfig
+from scenery_insitu_tpu.config import DeltaConfig, FaultConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
 from scenery_insitu_tpu.io.vdi_io import compress, decompress
@@ -91,9 +91,12 @@ def seq_delta(a: int, b: int, bits: int = 32) -> int:
 class StreamDrop:
     """Typed record of one message the subscriber refused: ``kind`` is
     ``"integrity"`` (failed checksum/size/shape validation before
-    decode), ``"stale"`` (duplicate or reordered sequence number) or
-    ``"malformed"`` (header unparseable). Returned instead of raising —
-    the stream outlives any single bad message."""
+    decode), ``"stale"`` (duplicate or reordered sequence number),
+    ``"malformed"`` (header unparseable) or ``"resync"`` (a temporal-
+    delta P/SKIP record whose base tile is not retained — an earlier
+    drop broke the chain; the stream recovers on the next forced
+    I-tile, within ``delta.iframe_period`` frames). Returned instead of
+    raising — the stream outlives any single bad message."""
 
     kind: str
     reason: str
@@ -193,12 +196,26 @@ class VDIPublisher(_HeartbeatPacer):
     def __init__(self, bind: str = "tcp://*:6655", codec: str = "zstd",
                  level: int = -1, precision: str = "f32",
                  fault: Optional[FaultConfig] = None,
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None,
+                 delta: Optional[DeltaConfig] = None):
         from scenery_insitu_tpu.io.vdi_io import resolve_codec
 
         if precision not in ("f32", "qpack8"):
             raise ValueError(f"precision must be 'f32' or 'qpack8', "
                              f"got {precision!r}")
+        # temporal-delta wire codec (docs/PERF.md "Temporal deltas"):
+        # per-tile SKIP / residual / I-tile records against the retained
+        # previous frame. Code-space comparison is only exact on the
+        # monotone qpack8 quantizer, so f32 + delta is a config error.
+        self._delta = None
+        if delta is not None and delta.enabled:
+            if precision != "qpack8":
+                raise ValueError(
+                    "delta.enabled requires precision='qpack8' (the "
+                    "P-frame codec compares qpack8 code space)")
+            from scenery_insitu_tpu.ops.delta import DeltaEncoder
+
+            self._delta = DeltaEncoder(delta.iframe_period)
         zmq = _zmq()
         # degrade the default codec when the optional zstandard package
         # is absent (the resolved name travels in every frame header, so
@@ -294,6 +311,7 @@ class VDIPublisher(_HeartbeatPacer):
             color = np.ascontiguousarray(np.asarray(vdi.color))
             depth = np.ascontiguousarray(np.asarray(vdi.depth))
             qscale = None
+            dhead = None
             if self.precision == "qpack8":
                 from scenery_insitu_tpu.ops.wire import (WIRE_CODES,
                                                          qpack8_quantize_np)
@@ -302,18 +320,33 @@ class VDIPublisher(_HeartbeatPacer):
                 qscale = [float(near), float(far)]
                 meta = meta._replace(
                     precision=np.int32(WIRE_CODES[self.precision]))
+                if self._delta is not None:
+                    # P-frame codec: the declared shapes stay the FULL
+                    # tile's code shapes; the blobs carry the record's
+                    # payload (ops/delta.py) and the delta header says
+                    # how to re-split it
+                    from scenery_insitu_tpu.io.vdi_io import (
+                        pack_delta_blobs)
+
+                    key = int(tile["tile"]) if tile else -1
+                    drec = self._delta.encode(key, color, depth, near,
+                                              far)
+                    dhead, cblob, dblob = pack_delta_blobs(
+                        drec, self.codec, self.level)
             else:
                 # stamp what THIS frame ships — a meta that rode in from a
                 # quantized hop must not mislabel the f32 buffers sent here
                 meta = meta._replace(precision=np.int32(0))
-            cblob = compress(np.ascontiguousarray(color).tobytes(),
-                             self.codec, self.level)
-            dblob = compress(np.ascontiguousarray(depth).tobytes(),
-                             self.codec, self.level)
+            if dhead is None:
+                cblob = compress(np.ascontiguousarray(color).tobytes(),
+                                 self.codec, self.level)
+                dblob = compress(np.ascontiguousarray(depth).tobytes(),
+                                 self.codec, self.level)
             fields = {
                 "codec": self.codec,
                 "precision": self.precision,
                 "qscale": qscale,
+                "delta": dhead,
                 "tile": tile,
                 # integrity + continuity (docs/ROBUSTNESS.md): CRCs are
                 # of the WIRE blobs, so truncation/corruption is caught
@@ -336,6 +369,19 @@ class VDIPublisher(_HeartbeatPacer):
         self.last_bytes = {"header": len(header), "color": len(cblob),
                            "depth": len(dblob)}
         return len(header) + len(cblob) + len(dblob)
+
+    def force_iframe(self) -> None:
+        """Scene cut: drop the delta codec's retained tiles so every
+        tile's next record is a full I-tile (a TF change or dataset
+        swap makes residuals meaningless; counted ``iframe_forced``).
+        No-op when the delta codec is off."""
+        if self._delta is not None:
+            self._delta.reset()
+
+    @property
+    def delta_stats(self) -> Optional[dict]:
+        """The delta encoder's record/byte accounting (None when off)."""
+        return None if self._delta is None else dict(self._delta.stats)
 
     def close(self) -> None:
         if self._hb_stop is not None:
@@ -366,12 +412,20 @@ class VDISubscriber(_ReconnectSupervisor):
 
     def __init__(self, connect: str = "tcp://localhost:6655",
                  fault: Optional[FaultConfig] = None):
+        from scenery_insitu_tpu.ops.delta import DeltaDecoder
+
         self.connect = connect
         self.fault = fault or FaultConfig()
         self.last_epoch: Optional[int] = None
         self.last_seq: Optional[int] = None
         self.stats = {"frames": 0, "drops": 0, "gaps": 0, "stale": 0,
-                      "heartbeats": 0, "epoch_changes": 0, "reconnects": 0}
+                      "heartbeats": 0, "epoch_changes": 0, "reconnects": 0,
+                      "resyncs": 0}
+        # temporal-delta reconstruction state (docs/PERF.md "Temporal
+        # deltas"): transparent — only messages carrying a delta header
+        # consult it, and an epoch change resets it (the restarted
+        # publisher's encoder shares no state with the old stream)
+        self._delta = DeltaDecoder()
         self._init_supervision(supervised=fault is not None)
         self._open()
 
@@ -432,13 +486,26 @@ class VDISubscriber(_ReconnectSupervisor):
         self.stats["drops"] += 1
         if kind == "stale":
             self.stats["stale"] += 1
+        if kind == "resync":
+            self.stats["resyncs"] += 1
         _obs.get_recorder().count("stream_drops")
-        _obs.degrade(
-            "stream.integrity" if kind != "stale" else "stream.gap",
-            "stream message", "dropped before decode",
-            ("duplicate or reordered message" if kind == "stale"
-             else "failed integrity validation (checksum/size/shape/"
-                  "header)"), warn=False)
+        if kind == "resync":
+            _obs.degrade(
+                "stream.delta_resync", "stream message",
+                "dropped before decode",
+                "temporal-delta record without its base tile retained; "
+                "recovering on the next I-tile (forced within "
+                "delta.iframe_period frames)", warn=False)
+        elif kind == "stale":
+            _obs.degrade(
+                "stream.gap", "stream message", "dropped before decode",
+                "duplicate or reordered message", warn=False)
+        else:
+            _obs.degrade(
+                "stream.integrity", "stream message",
+                "dropped before decode",
+                "failed integrity validation (checksum/size/shape/"
+                "header)", warn=False)
         return StreamDrop(kind, reason, epoch, seq)
 
     def _track_continuity(self, h: dict) -> Optional[StreamDrop]:
@@ -455,6 +522,11 @@ class VDISubscriber(_ReconnectSupervisor):
                          "publisher restarted (epoch changed); sequence "
                          "tracking reset", warn=False)
             self.last_seq = None
+            # the restarted publisher's delta encoder starts fresh — its
+            # first record per tile is an I-tile, so dropping the old
+            # retained tiles loses nothing and can never patch a new
+            # residual onto a stale base
+            self._delta.reset()
         self.last_epoch = epoch
         if self.last_seq is not None:
             d = seq_delta(seq, self.last_seq)
@@ -523,16 +595,29 @@ class VDISubscriber(_ReconnectSupervisor):
             return self._drop("integrity", "blob checksum mismatch",
                               epoch, seq)
         precision = h.get("precision", "f32")
+        dh = h.get("delta")
         cdt, ddt = ((np.uint32, np.uint16) if precision == "qpack8"
                     else (np.float32, np.float32))
         try:
-            craw = decompress(cblob, codec)
-            draw = decompress(dblob, codec)
+            craw = (decompress(cblob, codec) if cblob else b"")
+            draw = (decompress(dblob, codec) if dblob else b"")
         except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
             return self._drop("integrity", f"decompress failed: {e!r}",
                               epoch, seq)
-        want_c = int(np.prod(cshape)) * np.dtype(cdt).itemsize
-        want_d = int(np.prod(dshape)) * np.dtype(ddt).itemsize
+        if dh is not None:
+            # delta records declare the FULL tile's shapes but carry a
+            # record payload — the expected byte counts come from the
+            # delta header instead (io/vdi_io.delta_expected_bytes)
+            from scenery_insitu_tpu.io.vdi_io import delta_expected_bytes
+
+            try:
+                want_c, want_d = delta_expected_bytes(dh, cshape, dshape)
+            except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
+                return self._drop("malformed",
+                                  f"bad delta header: {e!r}", epoch, seq)
+        else:
+            want_c = int(np.prod(cshape)) * np.dtype(cdt).itemsize
+            want_d = int(np.prod(dshape)) * np.dtype(ddt).itemsize
         if len(craw) != want_c or len(draw) != want_d:
             # a truncated/corrupt blob must be rejected HERE — handing
             # it to frombuffer/reshape is the pre-PR crash
@@ -540,6 +625,41 @@ class VDISubscriber(_ReconnectSupervisor):
                 "integrity",
                 f"blob bytes ({len(craw)}, {len(draw)}) != declared "
                 f"shapes ({want_c}, {want_d})", epoch, seq)
+        if dh is not None:
+            # temporal-delta reconstruction: (retained tile + record) ->
+            # the current frame's qpack8 codes, bit-exact. A record
+            # whose base the decoder does not hold (an earlier message
+            # was dropped) is a resync wait, not an error.
+            from scenery_insitu_tpu.io.vdi_io import unpack_delta_payload
+            from scenery_insitu_tpu.ops.wire import qpack8_dequantize_np
+
+            try:
+                cpay, dpay = unpack_delta_payload(dh, craw, draw,
+                                                  cshape, dshape)
+                tile_h = h.get("tile")
+                key = int(tile_h["tile"]) if tile_h else -1
+                near, far = h["qscale"]
+                got = self._delta.apply(key, dh["mode"], int(dh["gen"]),
+                                        int(dh["base"]), cpay, dpay,
+                                        (float(near), float(far)))
+            except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
+                return self._drop("integrity",
+                                  f"delta decode failed: {e!r}",
+                                  epoch, seq)
+            if got is None:
+                return self._drop(
+                    "resync", f"{dh['mode']} record for tile {key} "
+                              f"patches generation {dh['base']} which "
+                              "is not retained", epoch, seq)
+            qc, qd, near, far = got
+            try:
+                color, depth = qpack8_dequantize_np(qc, qd, near, far)
+                meta = self._unpack_meta(h)
+            except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
+                return self._drop("integrity", f"decode failed: {e!r}",
+                                  epoch, seq)
+            self.stats["frames"] += 1
+            return VDI(color, depth), meta, h.get("tile")
         try:
             if precision == "qpack8":
                 # the publisher's pre-codec quantize pass (header
@@ -554,21 +674,25 @@ class VDISubscriber(_ReconnectSupervisor):
             else:
                 color = np.frombuffer(craw, np.float32).reshape(cshape)
                 depth = np.frombuffer(draw, np.float32).reshape(dshape)
-            m = h["meta"]
-            meta = VDIMetadata.create(
-                projection=np.asarray(m["projection"], np.float32),
-                view=np.asarray(m["view"], np.float32),
-                model=np.asarray(m["model"], np.float32),
-                volume_dims=np.asarray(m["volume_dims"], np.float32),
-                window_dims=np.asarray(m["window_dims"], np.int32),
-                nw=float(np.asarray(m["nw"])),
-                index=int(np.asarray(m["index"])),
-                precision=int(np.asarray(m.get("precision", 0))))
+            meta = self._unpack_meta(h)
         except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
             return self._drop("integrity", f"decode failed: {e!r}",
                               epoch, seq)
         self.stats["frames"] += 1
         return VDI(color, depth), meta, h.get("tile")
+
+    @staticmethod
+    def _unpack_meta(h: dict) -> VDIMetadata:
+        m = h["meta"]
+        return VDIMetadata.create(
+            projection=np.asarray(m["projection"], np.float32),
+            view=np.asarray(m["view"], np.float32),
+            model=np.asarray(m["model"], np.float32),
+            volume_dims=np.asarray(m["volume_dims"], np.float32),
+            window_dims=np.asarray(m["window_dims"], np.int32),
+            nw=float(np.asarray(m["nw"])),
+            index=int(np.asarray(m["index"])),
+            precision=int(np.asarray(m.get("precision", 0))))
 
     def close(self) -> None:
         self.sock.close(linger=0)

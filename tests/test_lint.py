@@ -103,7 +103,8 @@ class TestThread:
         assert set(knobs) == {"exchange", "ring_slots", "wire", "schedule",
                               "wave_tiles", "k_budget", "rebalance",
                               "rebalance_period", "rebalance_hysteresis",
-                              "rebalance_min_depth", "rebalance_quantum"}
+                              "rebalance_min_depth", "rebalance_quantum",
+                              "temporal_reuse"}
 
     def test_deleted_wire_forwarding_fails(self):
         """The acceptance-criteria demo: a builder whose wire= forwarding

@@ -108,6 +108,22 @@ def fmt(r: dict) -> str:
                          f"{row.get('modeled_ms_per_frame')} ms/frame "
                          f"x{row.get('speedup_vs_baseline')}")
         return "\n   ".join(lines)
+    if r.get("metric") == "serve_bench":          # edge-serving tier
+        am = r.get("amortization", {})
+        lines = [f"serve_bench: [{r.get('platform', '?')}] per-viewer "
+                 f"N=16 is x{r.get('value')} of N=1 "
+                 f"(verdicts={r.get('verdicts')})"]
+        for n, row in sorted(am.get("proxy", {}).items(),
+                             key=lambda kv: int(kv[0])):
+            lines.append(f"  N={n:>2s} {row['per_viewer_ms']:8.2f} "
+                         f"ms/viewer  {row['viewers_per_second']:7.1f} "
+                         "viewers/s")
+        lat = r.get("latency_ms", {})
+        lines.append(f"  fetch {am.get('fetch_ms')} ms + proxy build "
+                     f"{am.get('proxy_build_ms')} ms/frame; p50/p99 "
+                     f"{lat.get('p50')}/{lat.get('p99')} ms; "
+                     f"bytes/viewer {r.get('bytes_per_viewer')}")
+        return "\n   ".join(lines)
     if "metric" in r:
         val = r.get("value")
         unit = r.get("unit", "")

@@ -138,6 +138,13 @@ run_step() {
          SITPU_BENCH_REAL=1 python benchmarks/delta_bench.py \
          --grid 128 --frames 12 \
          --out "$R/delta_ab_tpu_${ROUND}.json" ;;
+    # edge-serving tier: viewers/chip/frame amortization curve + p99
+    # camera-to-pixel latency + bytes/viewer (docs/SERVING.md; the
+    # committed CPU capture is serve_bench_r13_cpu)
+    13) run_json "$R/serve_bench_tpu_${ROUND}.json" 1500 \
+         python benchmarks/serve_bench.py --grid 128 --k 20 \
+         --width 256 --height 192 --num-slices 128 \
+         --out "$R/serve_bench_tpu_${ROUND}.json" ;;
   esac
 }
 
@@ -155,10 +162,11 @@ step_out() {
     10) echo "$R/bench_tpu_${ROUND}_1024.json" ;;
     11) echo "$R/rebalance_ab_tpu_${ROUND}.json" ;;
     12) echo "$R/delta_ab_tpu_${ROUND}.json" ;;
+    13) echo "$R/serve_bench_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=12
+NSTEPS=13
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

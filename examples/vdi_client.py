@@ -8,6 +8,14 @@ examples/volume_from_file.py --publish:
 
     python examples/vdi_client.py --connect tcp://localhost:6655 \
         --frames 10 --out client_out/
+
+Tile-granular producers (composite.schedule="waves") work transparently:
+`VDISubscriber.receive` assembles tile messages into whole frames, so a
+mid-stream join waits for the next complete frame instead of mistaking
+one column block for the scene (ISSUE 13 fix). For many concurrent
+viewers of one stream, use the edge-serving tier instead —
+``python -m scenery_insitu_tpu.serve`` (docs/SERVING.md) — which
+batches all cameras into one render per frame.
 """
 
 import argparse

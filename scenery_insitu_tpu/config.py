@@ -519,6 +519,115 @@ class DeltaConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """VDI edge-serving tier (scenery_insitu_tpu/serve; docs/SERVING.md):
+    a `ViewerServer` subscribes to the composited VDI stream and answers
+    N concurrent client cameras per frame from ONE batched device
+    dispatch (`ops.vdi_novel.render_vdi_batch`), so sim+march+composite
+    stays O(1) while viewer cost scales on this separate, cacheable
+    tier. Every shed, stale or degraded answer is minted on the obs
+    ledger (serve.* components, docs/OBSERVABILITY.md)."""
+
+    # Client-facing ROUTER endpoint (":0" = ephemeral port for tests)
+    # and the upstream composited-VDI stream to subscribe to.
+    bind: str = "tcp://*:6657"
+    connect: str = "tcp://localhost:6655"
+    # Admission control: clients beyond max_viewers get a typed "shed"
+    # answer (serve.shed ledger) instead of service; pending camera
+    # requests beyond queue_cap shed the same way (requests coalesce
+    # latest-wins per client first, so the queue holds at most one
+    # request per admitted client).
+    max_viewers: int = 64
+    queue_cap: int = 64
+    # Cameras per render dispatch. A batch of B <= batch_size cameras
+    # pads up to the next `buckets` entry (replicating its last camera;
+    # padded lanes are discarded), so the jit cache holds at most
+    # len(buckets) programs per (tier, regime) — bounded recompiles.
+    batch_size: int = 16
+    buckets: Tuple[int, ...] = (1, 2, 4, 8, 16)
+    # Bounded staleness: answers rendered from a VDI more than this many
+    # frames behind the newest frame the stream has STARTED are stamped
+    # stale=True in the client protocol (+ serve.stale ledger) — the
+    # viewer knows it is looking at the past.
+    staleness_frames: int = 4
+    # Quality ladder (docs/SERVING.md "Tiers"): "exact" = closed-form
+    # render_vdi_exact; "proxy" = the MXU pre-shaded proxy volume, built
+    # once per frame and marched per viewer (the amortization winner);
+    # "wire" = the proxy render quantized to u8 wire precision (4x fewer
+    # bytes per viewer). Clients pick a tier at hello; unknown tiers
+    # degrade here (serve.tier ledger).
+    default_tier: str = "proxy"
+    # Camera-delta cache: a request whose camera moved by at most this
+    # (max-abs over every camera leaf) since the client's last answer ON
+    # THE SAME VDI FRAME re-serves the cached pixels without rendering.
+    cam_tol: float = 1e-6
+    # Served image size (fixed per server — per-request sizes would
+    # defeat the bounded-recompile contract).
+    width: int = 128
+    height: int = 96
+    # Novel-view plane count. 0 (the default) derives it per adopted
+    # frame from the VDI's own deepest finite slab (quantized to 16 so
+    # the jit key is stable) — this covers gather-engine VDIs, whose
+    # reconstructed plane ladder starts at the camera near plane well
+    # before the volume; a fixed count that stops short of the content
+    # serves BLANK frames on the proxy tier.
+    num_slices: int = 0
+    # Intermediate-grid scale of the per-viewer proxy march. The render
+    # path's 1.25x oversampling guards a RAW volume's features; the
+    # serve proxy is already pre-shaded at the VDI's own resolution, so
+    # 1.0 re-renders it without oversampling — ~1.6x cheaper per viewer,
+    # which is most of the amortization headroom (docs/SERVING.md).
+    march_scale: float = 1.0
+    # Clients silent (no request/heartbeat) this long are evicted; their
+    # next message re-admits them through admission control.
+    client_timeout_s: float = 10.0
+    # Liveness-supervise the upstream VDI subscription with fault.*
+    # knobs (reconnect + backoff past liveness_timeout_s). Off by
+    # default — the PR-11 convention: supervision needs a publisher
+    # that pumps heartbeats, or a healthy-but-slow stream gets torn
+    # down mid-frame.
+    supervise_stream: bool = False
+
+    def __post_init__(self):
+        if self.max_viewers < 1:
+            raise ValueError(f"max_viewers must be >= 1, "
+                             f"got {self.max_viewers}")
+        if self.queue_cap < 1:
+            raise ValueError(f"queue_cap must be >= 1, got {self.queue_cap}")
+        if self.batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, "
+                             f"got {self.batch_size}")
+        if not self.buckets or list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"buckets must be a strictly ascending ladder, "
+                             f"got {self.buckets}")
+        # buckets-vs-batch_size is a CROSS-FIELD constraint: it is
+        # checked where the pair is consumed (ViewerServer.__init__),
+        # not here — with_overrides applies one assignment at a time,
+        # and a per-assignment check would make override order decide
+        # whether a valid final config constructs.
+        if self.staleness_frames < 0:
+            raise ValueError(f"staleness_frames must be >= 0, "
+                             f"got {self.staleness_frames}")
+        if self.default_tier not in ("exact", "proxy", "wire"):
+            raise ValueError(f"default_tier must be 'exact', 'proxy' or "
+                             f"'wire', got {self.default_tier!r}")
+        if self.cam_tol < 0.0:
+            raise ValueError(f"cam_tol must be >= 0, got {self.cam_tol}")
+        if self.width < 8 or self.height < 8:
+            raise ValueError(f"served size must be >= 8x8, "
+                             f"got {self.width}x{self.height}")
+        if self.num_slices < 0:
+            raise ValueError(f"num_slices must be >= 0 (0 = heuristic), "
+                             f"got {self.num_slices}")
+        if self.march_scale <= 0.0:
+            raise ValueError(f"march_scale must be > 0, "
+                             f"got {self.march_scale}")
+        if self.client_timeout_s <= 0:
+            raise ValueError(f"client_timeout_s must be > 0, "
+                             f"got {self.client_timeout_s}")
+
+
+@dataclass(frozen=True)
 class StreamConfig:
     """Steering / streaming endpoints (≅ ZMQ :6655 + UDP :3337,
     VolumeFromFileExample.kt:840-854; DistributedVolumeRenderer.kt:278-283)."""
@@ -542,6 +651,7 @@ class FrameworkConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     delta: DeltaConfig = field(default_factory=DeltaConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     # ------------------------------------------------------------------ IO
     def to_dict(self) -> dict:

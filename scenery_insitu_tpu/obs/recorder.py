@@ -155,6 +155,16 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                           "(regime change or steering drain)",
     "session.scan_frames": "scan_frames configured but unsupported in "
                            "this mode; eager loop runs",
+    "serve.client": "edge server: a malformed or oversized client "
+                    "message was dropped; the serve loop keeps going",
+    "serve.shed": "edge server admission control refused a viewer or "
+                  "camera request (max_viewers/queue_cap); the client "
+                  "got a typed shed answer, not an exception",
+    "serve.stale": "edge server answered from a VDI more than "
+                   "serve.staleness_frames behind the stream head; "
+                   "answers are stamped stale",
+    "serve.tier": "a client requested an unknown quality tier; the "
+                  "serve.default_tier renders instead",
     "session.sink": "a frame/tile sink or on_steer callback failed "
                     "max_sink_failures consecutive times and is "
                     "quarantined (disabled) for the rest of the run",

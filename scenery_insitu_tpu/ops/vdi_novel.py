@@ -35,7 +35,7 @@ traversed-fraction law the rest of the framework uses.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -321,9 +321,131 @@ def render_vdi_any(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,
     cfg = slicer_cfg or SliceMarchConfig(matmul_dtype=spec0.matmul_dtype)
     spec_new = slicer.make_spec(cam, proxy.data.shape[-3:], cfg,
                                 axis_sign=(new_axis, new_sign))
+    return render_vdi_proxy(proxy, cam, width, height, spec_new,
+                            background=background)
+
+
+def render_vdi_proxy(proxy, cam: Camera, width: int, height: int,
+                     spec_new: AxisSpec,
+                     background: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0)
+                     ) -> jnp.ndarray:
+    """March a prebuilt `vdi_to_rgba_volume` proxy from one camera ->
+    f32[4, H, W] premultiplied — the per-view half of the proxy path
+    (`render_vdi_any` builds + marches in one call; the serving tier
+    builds the proxy ONCE per VDI frame and marches it per viewer, so the
+    split is the amortization seam). ``spec_new`` must be the static spec
+    of the proxy's grid for the camera's march regime — required
+    explicitly because ``cam`` may be traced (the batched path maps over
+    cameras inside one compiled program)."""
     out = slicer.raycast_mxu(proxy, None, cam, width, height, spec_new,
                              background=background)
     return out.image
+
+
+def stack_cameras(cams: Sequence[Camera]) -> Camera:
+    """Stack N cameras into one batched Camera pytree (every leaf gains a
+    leading [N] axis) — the input shape of `render_vdi_batch`."""
+    cams = list(cams)
+    if not cams:
+        raise ValueError("stack_cameras needs at least one camera")
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *cams)
+
+
+def render_vdi_batch(vdi: Optional[VDI], axcam0: Optional[AxisCamera],
+                     spec0: AxisSpec, cams: Camera, width: int, height: int,
+                     *, tier: str = "proxy",
+                     num_slices: Optional[int] = None,
+                     axis_sign: Optional[Tuple[int, int]] = None,
+                     proxy=None, spec_new: Optional[AxisSpec] = None,
+                     slicer_cfg=None,
+                     background: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0)
+                     ) -> jnp.ndarray:
+    """Batched novel-view rendering: N cameras (one stacked Camera pytree,
+    `stack_cameras`) against ONE VDI in ONE compiled dispatch ->
+    f32[N, 4, H, W]. The edge-serving tier's core op (docs/SERVING.md):
+    the VDI fetch, the slab decode and (on the proxy tier) the whole
+    pre-shaded proxy expansion are paid once per frame and amortized
+    across every viewer in the batch.
+
+    The batch axis runs under ``jax.lax.map`` (sweep/proxy tiers) — a
+    scan whose body is the UNMODIFIED single-camera renderer — rather
+    than ``jax.vmap``: batched matmul shapes change XLA's
+    contraction/fusion choices, so a vmapped batch drifts ~1e-5 from the
+    independent single calls, while the scanned body is the same program
+    element-for-element. The exact tier unrolls the batch instead
+    (stacked copies of the single-camera graph inside one program):
+    under lax.map its camera-independent slab sort is hoisted out of the
+    loop with a different fusion and drifts ~2e-6 — the unroll keeps
+    each element the literal single-camera graph, at a compile cost
+    bounded by the serve bucket ladder. Contract (tests pin all three):
+    each batch element is BITWISE equal to the independent
+    `render_vdi_exact` / `render_vdi_mxu` / `render_vdi_proxy` call,
+    elements are independent of what else shares the batch, and padding
+    a batch to a larger bucket leaves the real entries bit-unchanged.
+
+    Tiers (the serving quality ladder):
+
+    - ``"exact"``   `render_vdi_exact` per camera — any regime, the
+                    quality reference; every stage is per-camera, so the
+                    batch amortizes only the dispatch + VDI fetch.
+    - ``"sweep"``   `render_vdi_mxu` per camera — the same-regime direct
+                    plane sweep (``axis_sign`` REQUIRED and shared by the
+                    whole batch; cameras are traced inside the scan).
+                    The per-plane decode is camera-independent and
+                    hoisted out of the scan by XLA.
+    - ``"proxy"``   `render_vdi_proxy` per camera over one shared
+                    `vdi_to_rgba_volume` expansion (prebuilt ``proxy``
+                    or built here) — ANY regime per bucket via
+                    ``spec_new``/``axis_sign``, and the strongest
+                    amortization: the expansion (decode + resample of
+                    every plane) is outside the scan entirely. With
+                    ``proxy`` and ``spec_new`` given, ``vdi``/``axcam0``
+                    may be None (the serving loop holds the proxy, not
+                    the VDI).
+    """
+    if tier == "exact":
+        b = jax.tree_util.tree_leaves(cams)[0].shape[0]
+        return jnp.stack([
+            render_vdi_exact(
+                vdi, axcam0, spec0,
+                jax.tree_util.tree_map(lambda x: x[i], cams),
+                width, height, background=background)
+            for i in range(b)])
+    if tier == "sweep":
+        if axis_sign is None:
+            raise ValueError(
+                "tier='sweep' needs the batch's shared axis_sign regime "
+                "(cameras are traced inside the scan, so choose_axis "
+                "cannot run per element)")
+        return jax.lax.map(
+            lambda c: render_vdi_mxu(vdi, axcam0, spec0, c, width, height,
+                                     num_slices=num_slices,
+                                     background=background,
+                                     axis_sign=axis_sign),
+            cams)
+    if tier != "proxy":
+        raise ValueError(f"unknown tier {tier!r} "
+                         "(expected 'exact', 'sweep' or 'proxy')")
+    if proxy is None:
+        if vdi is None or axcam0 is None:
+            raise ValueError("tier='proxy' needs either a prebuilt proxy "
+                             "or the (vdi, axcam0) pair to build one")
+        proxy = vdi_to_rgba_volume(vdi, axcam0, spec0,
+                                   num_slices=num_slices)
+    if spec_new is None:
+        if axis_sign is None:
+            raise ValueError(
+                "tier='proxy' needs spec_new or the batch's shared "
+                "axis_sign regime to derive it")
+        from scenery_insitu_tpu.config import SliceMarchConfig
+        cfg = slicer_cfg or SliceMarchConfig(matmul_dtype=spec0.matmul_dtype)
+        cam0 = jax.tree_util.tree_map(lambda x: x[0], cams)
+        spec_new = slicer.make_spec(cam0, proxy.data.shape[-3:], cfg,
+                                    axis_sign=axis_sign)
+    return jax.lax.map(
+        lambda c: render_vdi_proxy(proxy, c, width, height, spec_new,
+                                   background=background),
+        cams)
 
 
 def render_vdi_exact(vdi: VDI, axcam0: AxisCamera, spec0: AxisSpec,

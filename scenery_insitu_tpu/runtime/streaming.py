@@ -96,12 +96,17 @@ class StreamDrop:
     delta P/SKIP record whose base tile is not retained — an earlier
     drop broke the chain; the stream recovers on the next forced
     I-tile, within ``delta.iframe_period`` frames). Returned instead of
-    raising — the stream outlives any single bad message."""
+    raising — the stream outlives any single bad message. ``frame`` is
+    the refused message's frame index when its header parsed far enough
+    to carry one — a refused frame still STARTED, so stream-head
+    bookkeeping (the serving tier's bounded-staleness clock) must
+    advance past it."""
 
     kind: str
     reason: str
     epoch: Optional[int] = None
     seq: Optional[int] = None
+    frame: Optional[int] = None
 
 
 _HEARTBEAT = object()        # receive-loop sentinel: liveness, not a frame
@@ -426,6 +431,12 @@ class VDISubscriber(_ReconnectSupervisor):
         # consult it, and an epoch change resets it (the restarted
         # publisher's encoder shares no state with the old stream)
         self._delta = DeltaDecoder()
+        # whole-frame transparency for `receive` (bugfix, ISSUE 13): a
+        # consumer that joins a TILE-granular stream mid-frame must not
+        # mistake one column block for the whole frame the metadata
+        # describes — tile messages assemble here and only complete
+        # frames surface
+        self._assembler = None
         self._init_supervision(supervised=fault is not None)
         self._open()
 
@@ -444,10 +455,37 @@ class VDISubscriber(_ReconnectSupervisor):
 
     def receive(self, timeout_ms: Optional[int] = None
                 ) -> Union[None, StreamDrop, Tuple[VDI, VDIMetadata]]:
-        got = self.receive_tile(timeout_ms)
-        if got is None or isinstance(got, StreamDrop):
-            return got
-        return got[:2]
+        """Whole-frame receive. Whole-frame messages return directly;
+        TILE messages (`VDIPublisher.publish_tile`) feed an internal
+        `FrameAssembler` and only COMPLETE frames surface — pre-fix a
+        tile message came back as if it were the frame its metadata
+        describes (window_dims names the FULL width), so every
+        whole-frame consumer (examples/vdi_client.py, the serve tier)
+        silently rendered one column block as the scene. A consumer
+        joining mid-stream therefore waits for the next frame whose
+        tiles it saw from tile 0 — the same "first contact must wait"
+        contract the temporal-delta codec has (a P/SKIP record before
+        the first I-tile is a typed ``resync`` StreamDrop, never an
+        error). Returns None on timeout, StreamDrop for refused
+        messages, else (VDI, metadata)."""
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + timeout_ms / 1000.0)
+        while True:
+            wait = (None if deadline is None else
+                    max(0, int((deadline - time.monotonic()) * 1000)))
+            got = self.receive_tile(wait)
+            if got is None or isinstance(got, StreamDrop):
+                return got
+            vdi, meta, tile = got
+            if tile is None:
+                return vdi, meta
+            if self._assembler is None:
+                self._assembler = FrameAssembler(fault=self.fault)
+            out = self._assembler.add(vdi, meta, tile)
+            if out is not None:
+                return out
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
 
     def receive_tile(self, timeout_ms: Optional[int] = None
                      ) -> Union[None, StreamDrop,
@@ -482,7 +520,7 @@ class VDISubscriber(_ReconnectSupervisor):
 
     # ------------------------------------------------------- validation
     def _drop(self, kind: str, reason: str, epoch=None,
-              seq=None) -> StreamDrop:
+              seq=None, frame=None) -> StreamDrop:
         self.stats["drops"] += 1
         if kind == "stale":
             self.stats["stale"] += 1
@@ -506,7 +544,16 @@ class VDISubscriber(_ReconnectSupervisor):
                 "dropped before decode",
                 "failed integrity validation (checksum/size/shape/"
                 "header)", warn=False)
-        return StreamDrop(kind, reason, epoch, seq)
+        return StreamDrop(kind, reason, epoch, seq, frame)
+
+    @staticmethod
+    def _header_frame(h: dict) -> Optional[int]:
+        """Best-effort frame index from a parsed header — StreamDrop
+        bookkeeping only; the caller mints the drop itself."""
+        try:
+            return int(np.asarray(h["meta"]["index"]))
+        except Exception:  # sitpu-lint: disable=SITPU-LEDGER (bookkeeping; the caller mints the drop)
+            return None
 
     def _track_continuity(self, h: dict) -> Optional[StreamDrop]:
         """Update epoch/seq tracking from one parsed header; returns a
@@ -527,13 +574,17 @@ class VDISubscriber(_ReconnectSupervisor):
             # retained tiles loses nothing and can never patch a new
             # residual onto a stale base
             self._delta.reset()
+            # partial tile frames from the old incarnation can never
+            # complete (its frame indices restart too) — drop them
+            # rather than pasting old-epoch tiles into new-epoch frames
+            self._assembler = None
         self.last_epoch = epoch
         if self.last_seq is not None:
             d = seq_delta(seq, self.last_seq)
             if d <= 0:
                 return self._drop("stale",
                                   f"seq {seq} after {self.last_seq}",
-                                  epoch, seq)
+                                  epoch, seq, self._header_frame(h))
             if d > 1:
                 self.stats["gaps"] += d - 1
                 _obs.get_recorder().count("stream_gap_messages", d - 1)
@@ -581,6 +632,7 @@ class VDISubscriber(_ReconnectSupervisor):
         except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
             return self._drop("malformed", f"bad header: {e!r}")
         epoch, seq = h.get("epoch"), h.get("seq")
+        fidx = self._header_frame(h)
         # continuity first, ONCE: a message that is both stale and
         # corrupt is one refusal, not two ledger rows. A corrupt blob
         # still advances seq tracking — the header parsed, so the
@@ -593,7 +645,7 @@ class VDISubscriber(_ReconnectSupervisor):
         if crc is not None and list(crc) != [zlib.crc32(cblob),
                                              zlib.crc32(dblob)]:
             return self._drop("integrity", "blob checksum mismatch",
-                              epoch, seq)
+                              epoch, seq, fidx)
         precision = h.get("precision", "f32")
         dh = h.get("delta")
         cdt, ddt = ((np.uint32, np.uint16) if precision == "qpack8"
@@ -603,7 +655,7 @@ class VDISubscriber(_ReconnectSupervisor):
             draw = (decompress(dblob, codec) if dblob else b"")
         except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
             return self._drop("integrity", f"decompress failed: {e!r}",
-                              epoch, seq)
+                              epoch, seq, fidx)
         if dh is not None:
             # delta records declare the FULL tile's shapes but carry a
             # record payload — the expected byte counts come from the
@@ -614,7 +666,8 @@ class VDISubscriber(_ReconnectSupervisor):
                 want_c, want_d = delta_expected_bytes(dh, cshape, dshape)
             except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
                 return self._drop("malformed",
-                                  f"bad delta header: {e!r}", epoch, seq)
+                                  f"bad delta header: {e!r}", epoch,
+                                  seq, fidx)
         else:
             want_c = int(np.prod(cshape)) * np.dtype(cdt).itemsize
             want_d = int(np.prod(dshape)) * np.dtype(ddt).itemsize
@@ -624,7 +677,7 @@ class VDISubscriber(_ReconnectSupervisor):
             return self._drop(
                 "integrity",
                 f"blob bytes ({len(craw)}, {len(draw)}) != declared "
-                f"shapes ({want_c}, {want_d})", epoch, seq)
+                f"shapes ({want_c}, {want_d})", epoch, seq, fidx)
         if dh is not None:
             # temporal-delta reconstruction: (retained tile + record) ->
             # the current frame's qpack8 codes, bit-exact. A record
@@ -645,19 +698,19 @@ class VDISubscriber(_ReconnectSupervisor):
             except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
                 return self._drop("integrity",
                                   f"delta decode failed: {e!r}",
-                                  epoch, seq)
+                                  epoch, seq, fidx)
             if got is None:
                 return self._drop(
                     "resync", f"{dh['mode']} record for tile {key} "
                               f"patches generation {dh['base']} which "
-                              "is not retained", epoch, seq)
+                              "is not retained", epoch, seq, fidx)
             qc, qd, near, far = got
             try:
                 color, depth = qpack8_dequantize_np(qc, qd, near, far)
                 meta = self._unpack_meta(h)
             except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
                 return self._drop("integrity", f"decode failed: {e!r}",
-                                  epoch, seq)
+                                  epoch, seq, fidx)
             self.stats["frames"] += 1
             return VDI(color, depth), meta, h.get("tile")
         try:
@@ -677,7 +730,7 @@ class VDISubscriber(_ReconnectSupervisor):
             meta = self._unpack_meta(h)
         except Exception as e:  # sitpu-lint: disable=SITPU-LEDGER (drops mint via _drop)
             return self._drop("integrity", f"decode failed: {e!r}",
-                              epoch, seq)
+                              epoch, seq, fidx)
         self.stats["frames"] += 1
         return VDI(color, depth), meta, h.get("tile")
 
@@ -774,12 +827,18 @@ class FrameAssembler:
 
 def make_camera_message(cam: Camera) -> dict:
     """Viewer -> renderer camera pose (≅ the msgpack camera payload,
-    VolumeFromFileExample.kt:907-918)."""
+    VolumeFromFileExample.kt:907-918). Carries the FULL camera —
+    near/far included: the serve tier re-renders through this pose, and
+    the near plane participates in ray generation, so an elided clip
+    range would silently shift every served pixel (steering consumers
+    ignore the extra fields)."""
     return {"type": "camera",
             "eye": np.asarray(cam.eye).tolist(),
             "target": np.asarray(cam.target).tolist(),
             "up": np.asarray(cam.up).tolist(),
-            "fov_y": float(np.asarray(cam.fov_y))}
+            "fov_y": float(np.asarray(cam.fov_y)),
+            "near": float(np.asarray(cam.near)),
+            "far": float(np.asarray(cam.far))}
 
 
 def make_tf_message(points, colormap: str = "hot") -> dict:

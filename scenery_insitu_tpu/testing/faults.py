@@ -385,6 +385,181 @@ def run_matrix(seed: int = 0, frames: int = 12) -> dict:
     scenario("steering/malformed_oversized", ["stream.steering"],
              steering)
 
+    # --- serving tier (ISSUE 13): churn, backpressure, delta mid-join ---
+    def _serve_fixture():
+        """A tiny REAL slice-march VDI (the matrix's synthetic identity
+        matrices are not a renderable camera) + a loopback server."""
+        from scenery_insitu_tpu.config import (FrameworkConfig,
+                                               SliceMarchConfig,
+                                               VDIConfig)
+        from scenery_insitu_tpu.core.camera import Camera
+        from scenery_insitu_tpu.core.transfer import for_dataset
+        from scenery_insitu_tpu.core.volume import procedural_volume
+        from scenery_insitu_tpu.ops import slicer
+
+        vol = procedural_volume(16, kind="blobs", seed=seed)
+        cam0 = Camera.create((0.1, 0.3, 2.8), fov_y_deg=45.0, near=0.3,
+                             far=10.0)
+        spec = slicer.make_spec(
+            cam0, vol.data.shape, SliceMarchConfig(matmul_dtype="f32"))
+        svdi, smeta, _ = slicer.generate_vdi_mxu(
+            vol, for_dataset("procedural"), cam0, spec,
+            VDIConfig(max_supersegments=4, adaptive_iters=1))
+        cfg = FrameworkConfig().with_overrides(
+            "serve.width=24", "serve.height=20", "serve.num_slices=8",
+            "serve.batch_size=4", "serve.buckets=[1,2,4]")
+        return svdi, smeta, cam0, cfg
+
+    def _pump_serve(srv, clients, secs):
+        import time as _t
+
+        from scenery_insitu_tpu.serve import ViewerFrame
+
+        deadline = _t.monotonic() + secs
+        answers = 0
+        while _t.monotonic() < deadline:
+            srv.run_once(timeout_ms=10)
+            for c in clients:
+                got = c.poll(timeout_ms=0)
+                if isinstance(got, ViewerFrame):
+                    answers += 1
+        return answers
+
+    def serve_churn():
+        """Clients joining and leaving MID-FRAME while the server
+        answers: admissions beyond max_viewers shed typed, leavers are
+        forgotten, the server never raises."""
+        from scenery_insitu_tpu.core.camera import orbit
+        from scenery_insitu_tpu.runtime.streaming import VDIPublisher
+        from scenery_insitu_tpu.serve import ViewerClient, ViewerServer
+
+        svdi, smeta, cam0, cfg = _serve_fixture()
+        cfg = cfg.with_overrides("serve.max_viewers=2")
+        pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib")
+        srv = ViewerServer(cfg, connect=pub.endpoint,
+                           bind="tcp://127.0.0.1:0")
+        churned = []
+        try:
+            time.sleep(0.2)
+            pub.publish(svdi, smeta)
+            deadline = time.monotonic() + 20
+            while srv.frame is None and time.monotonic() < deadline:
+                srv.pump_stream(timeout_ms=100)
+            assert srv.frame is not None
+            answers = 0
+            for round_ in range(3):
+                batch = [ViewerClient(srv.endpoint, tier="proxy")
+                         for _ in range(4)]        # 4 > max_viewers=2
+                churned.extend(batch)
+                for i, c in enumerate(batch):
+                    c.request(orbit(cam0, 0.05 * i + 0.02 * round_))
+                answers += _pump_serve(srv, batch, 1.0)
+                for c in batch[:2]:                # leavers mid-stream
+                    c.bye()
+                srv.pump_clients()
+            assert answers > 0
+            assert srv.stats["sheds"] > 0
+            return {"answers": answers, "server_stats": dict(srv.stats)}
+        finally:
+            for c in churned:
+                c.close()
+            srv.close()
+            pub.close()
+    scenario("serve/client_churn", ["serve.shed"], serve_churn)
+
+    def serve_backpressure():
+        """A slow/flooding client vs admission control: its own requests
+        coalesce latest-wins, and distinct clients beyond queue_cap shed
+        typed — the serve loop never blocks on the slow consumer."""
+        from scenery_insitu_tpu.core.camera import orbit
+        from scenery_insitu_tpu.runtime.streaming import VDIPublisher
+        from scenery_insitu_tpu.serve import (ServeDrop, ViewerClient,
+                                              ViewerServer)
+
+        svdi, smeta, cam0, cfg = _serve_fixture()
+        cfg = cfg.with_overrides("serve.max_viewers=4",
+                                 "serve.queue_cap=1")
+        pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib")
+        srv = ViewerServer(cfg, connect=pub.endpoint,
+                           bind="tcp://127.0.0.1:0")
+        flooder = ViewerClient(srv.endpoint, tier="proxy")
+        other = ViewerClient(srv.endpoint, tier="proxy")
+        try:
+            time.sleep(0.2)
+            pub.publish(svdi, smeta)
+            deadline = time.monotonic() + 20
+            while srv.frame is None and time.monotonic() < deadline:
+                srv.pump_stream(timeout_ms=100)
+            # the flooder never reads; its burst coalesces to one slot
+            for i in range(6):
+                flooder.request(orbit(cam0, 0.03 * i))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not srv.queue:
+                srv.pump_clients()
+                time.sleep(0.01)
+            srv.pump_clients()
+            assert len(srv.queue) <= 1
+            # a second client against the full queue: typed shed
+            other.request(orbit(cam0, 0.5))
+            shed = None
+            deadline = time.monotonic() + 10
+            while shed is None and time.monotonic() < deadline:
+                srv.pump_clients()
+                got = other.poll(timeout_ms=10)
+                if isinstance(got, ServeDrop) and got.kind == "shed":
+                    shed = got
+            assert shed is not None and shed.reason == "queue_cap"
+            return {"server_stats": dict(srv.stats),
+                    "coalesced": srv.stats["coalesced"]}
+        finally:
+            flooder.close()
+            other.close()
+            srv.close()
+            pub.close()
+    scenario("serve/slow_client_backpressure", ["serve.shed"],
+             serve_backpressure)
+
+    def serve_delta_midjoin():
+        """The serve subscriber joins a temporal-delta stream
+        mid-flight: P/SKIP records before the first I-frame are typed
+        resync drops, and the server is whole within iframe_period."""
+        from scenery_insitu_tpu.config import DeltaConfig
+        from scenery_insitu_tpu.runtime.streaming import VDIPublisher
+        from scenery_insitu_tpu.serve import ViewerServer
+
+        svdi, smeta, cam0, cfg = _serve_fixture()
+        # iframe_period is generous so MANY P records precede the forced
+        # I: the subscriber's SUB join settles while P-frames flow, and
+        # the scenario's resync-drop assertion never races the first
+        # I-frame on a loaded runner
+        pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib",
+                           precision="qpack8", epoch=seed + 1,
+                           delta=DeltaConfig(enabled=True,
+                                             iframe_period=16))
+        # the stream is already past its first I-frame when we join
+        pub.publish(svdi, smeta._replace(index=np.int32(0)))
+        pub.publish(svdi, smeta._replace(index=np.int32(1)))
+        srv = ViewerServer(cfg, connect=pub.endpoint,
+                           bind="tcp://127.0.0.1:0")
+        try:
+            time.sleep(0.2)
+            deadline = time.monotonic() + 20
+            i = 2
+            while srv.frame is None and time.monotonic() < deadline:
+                pub.publish(svdi, smeta._replace(index=np.int32(i)))
+                i += 1
+                srv.pump_stream(timeout_ms=300)
+            assert srv.frame is not None, "never recovered on an I-frame"
+            assert srv.stats["stream_drops"] > 0    # the resync waits
+            return {"frames_published": i,
+                    "server_stats": dict(srv.stats),
+                    "subscriber_stats": dict(srv.sub.stats)}
+        finally:
+            srv.close()
+            pub.close()
+    scenario("serve/delta_resync_midjoin", ["stream.delta_resync"],
+             serve_delta_midjoin)
+
     # --- subscriber liveness reconnect ----------------------------------
     def liveness():
         sub = VDISubscriber(

@@ -66,6 +66,12 @@ HBM_GBPS = 819.0
 # effective per-link ICI assumption for a v5e 1-D ring (conservative
 # fraction of the ~400 GB/s aggregate the data sheet quotes per chip).
 ICI_GBPS = 45.0
+# effective per-host DCN assumption for the inter-domain hop of the
+# hierarchical composite (docs/MULTIHOST.md) — a conservative 25 Gbit/s
+# of usable cross-host bandwidth (~1/14 of the ICI link): DCN is the
+# slow level by construction, which is the whole reason the composite
+# splits into two levels instead of running one flat exchange over it.
+DCN_GBPS = 3.125
 
 
 def _load(rel, default=None):
@@ -231,6 +237,60 @@ def main():
                 f"run steadiness, not grid size",
     })
 
+    # ---- multi-host scale-out scenario (ISSUE 14): the full-lever
+    # stack per DOMAIN plus the inter-domain DCN hop of the two-level
+    # composite (parallel/hier.py). Per host the DCN term is
+    # modeled_dcn_traffic's ring bytes over the stated DCN bandwidth —
+    # what a FLAT exchange would pay instead is every rank's whole
+    # (n-1)-fragment exchange crossing DCN, priced alongside so the
+    # two-level win is explicit. Grid scales weakly (fixed per-rank
+    # volume: H hosts render an H-times-deeper volume at the same
+    # per-frame cost + the DCN term).
+    from scenery_insitu_tpu.parallel.hier import modeled_dcn_traffic
+
+    def ms_dcn(nbytes):
+        return nbytes / (DCN_GBPS * 1e9) * 1e3
+
+    full_stack = next(r for r in stack if r["lever"] == "+tile_waves")
+    flat_ex = modeled_exchange_traffic(RANKS, K, NJ, NI, k_out=K,
+                                       mode="ring", ring_slots=K,
+                                       wire="qpack8")
+    for hosts in (2, 4):
+        dcn = modeled_dcn_traffic(hosts, RANKS, K, NJ, NI,
+                                  dcn_wire="qpack8", ring_slots=K)
+        ms = dict(full_stack["ms"])
+        # PER-HOST bytes over the PER-HOST link: all of a host's ranks
+        # funnel through its shared DCN NIC (DCN_GBPS is per host)
+        ms["dcn_exchange"] = round(
+            ms_dcn(dcn["dcn_bytes_sent_per_host"]), 2)
+        # a flat H*RANKS-rank exchange would push (H-1)/H of every
+        # rank's fragment traffic across DCN instead — the same
+        # per-host funnel prices all RANKS ranks' share
+        flat_over_dcn = round(
+            ms_dcn(flat_ex["ici_bytes_per_rank"] * RANKS
+                   * (hosts - 1) / hosts), 2)
+        stack.append({
+            "lever": f"+hier_composite_{hosts}hosts",
+            "config": {**full_stack["config"],
+                       "scenario": "multi-host weak scale-out",
+                       "num_hosts": hosts, "dcn_wire": "qpack8",
+                       "grid": [GRID * hosts, GRID, GRID]},
+            "bytes": {**full_stack["bytes"],
+                      "dcn_per_rank": dcn["dcn_bytes_sent_per_rank"],
+                      "dcn_per_host": dcn["dcn_bytes_sent_per_host"]},
+            "ms": ms,
+            "modeled_ms_per_frame": round(sum(ms.values()), 2),
+            "flat_exchange_over_dcn_ms": flat_over_dcn,
+            "note": f"SCENARIO row (ISSUE 14): {hosts} ICI domains over "
+                    f"DCN at {DCN_GBPS} GB/s/host — the two-level "
+                    f"composite ships the capped accumulator's column "
+                    f"sub-blocks ({ms['dcn_exchange']} ms) where a flat "
+                    f"{hosts * RANKS}-rank exchange would drag "
+                    f"{flat_over_dcn} ms of fragment traffic across "
+                    f"DCN; volume scales weakly to "
+                    f"{GRID * hosts}x{GRID}x{GRID}",
+        })
+
     b0 = stack[0]["modeled_ms_per_frame"]
     for r_ in stack:
         r_["speedup_vs_baseline"] = round(b0 / r_["modeled_ms_per_frame"],
@@ -260,6 +320,7 @@ def main():
             "wave_tiles": WAVE_TILES,
             "marches_per_frame": 1,
             "hbm_gbps": HBM_GBPS, "ici_gbps_effective": ICI_GBPS,
+            "dcn_gbps_effective_per_host": DCN_GBPS,
             "occupancy_march_reduction_source":
                 "benchmarks/results/occupancy_ab_r06_512.json (sim row)",
             "straggler_factor_source":

@@ -108,6 +108,34 @@ def fmt(r: dict) -> str:
                          f"{row.get('modeled_ms_per_frame')} ms/frame "
                          f"x{row.get('speedup_vs_baseline')}")
         return "\n   ".join(lines)
+    if str(r.get("metric", "")).startswith("hier_weak_scaling"):
+        # hierarchical weak scaling through the subprocess harness
+        lines = [f"{r['metric']}: weak_efficiency={r.get('value')} "
+                 f"(dcn_wire={r.get('config', {}).get('dcn_wire')})"]
+        for row in r.get("sweep", []):
+            if "error" in row:
+                lines.append(f"  hosts={row.get('hosts')} ERROR "
+                             f"{row['error']}")
+                continue
+            mod = row.get("modeled", {})
+            lines.append(
+                f"  hosts={row['hosts']} ranks={row['n_ranks']} "
+                f"{row['ms_per_frame']:8.1f} ms/frame  dcn "
+                f"{row['dcn_bytes_sent_per_host_measured']} B/host "
+                f"(modeled raw {mod.get('dcn_bytes_sent_per_host')})")
+        return "\n   ".join(lines)
+    if str(r.get("metric", "")).startswith("hier_device_ab"):
+        # flat vs hierarchical device-path A/B (watcher step 14)
+        lines = [f"{r['metric']}: flat {r.get('flat_ms_per_frame')} "
+                 f"ms/frame ({r.get('devices')} dev, {r.get('grid')}^3)"]
+        for key, h in sorted((r.get("hier") or {}).items()):
+            lines.append(
+                f"  {key:5s} {h.get('ms_per_frame')} ms/frame "
+                f"(x{h.get('vs_flat')} vs flat, parity "
+                f"{h.get('parity_max_abs_diff')})")
+        if r.get("note"):
+            lines.append(f"  note: {r['note']}")
+        return "\n   ".join(lines)
     if r.get("metric") == "serve_bench":          # edge-serving tier
         am = r.get("amortization", {})
         lines = [f"serve_bench: [{r.get('platform', '?')}] per-viewer "

@@ -145,6 +145,13 @@ run_step() {
          python benchmarks/serve_bench.py --grid 128 --k 20 \
          --width 256 --height 192 --num-slices 128 \
          --out "$R/serve_bench_tpu_${ROUND}.json" ;;
+    # hierarchical two-level composite A/B on real devices (domains as
+    # mesh sub-axes — docs/MULTIHOST.md; the committed CPU captures are
+    # hier_scaling_r14_cpu + the emulated-path parity tests). On a
+    # 1-chip tunnel this records the documented degenerate note.
+    14) run_json "$R/hier_device_tpu_${ROUND}.json" 1200 env \
+         SITPU_BENCH_REAL=1 python benchmarks/scaling_bench.py \
+         --mode hier-device --grid 128 --k 8 --frames 10 ;;
   esac
 }
 
@@ -163,10 +170,11 @@ step_out() {
     11) echo "$R/rebalance_ab_tpu_${ROUND}.json" ;;
     12) echo "$R/delta_ab_tpu_${ROUND}.json" ;;
     13) echo "$R/serve_bench_tpu_${ROUND}.json" ;;
+    14) echo "$R/hier_device_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=13
+NSTEPS=14
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

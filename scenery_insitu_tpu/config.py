@@ -344,6 +344,53 @@ class CompositeConfig:
 
 
 @dataclass(frozen=True)
+class TopologyConfig:
+    """Mesh topology — the scale-out plane (docs/MULTIHOST.md).
+
+    Every collective in the single-domain pipeline assumes one flat ICI
+    domain. This block makes the ICI/DCN split first-class: ``num_hosts``
+    ICI domains ("hosts" — one per pod slice / node) of ``domain_size``
+    devices each. With ``num_hosts > 1`` the compositing mesh becomes a
+    2-D ``(hosts, ranks)`` mesh (parallel/topology.py) and the sort-last
+    composite runs in TWO levels: intra-domain ring/waves over ICI
+    exactly as today, then an inter-domain exchange of already-partially-
+    composited column blocks over DCN (parallel/hier.py), resegmented
+    ONCE so a hierarchical frame matches the flat composite
+    (tests/test_topology.py). ``num_hosts == 1`` (the default) is
+    BITWISE the flat single-level path."""
+
+    # Devices per ICI domain. 0 = auto: all devices / num_hosts (the
+    # device count must split evenly — parallel/topology.py validates).
+    domain_size: int = 0
+    # ICI domains (hosts). 1 = the flat single-domain path, bitwise
+    # identical to the pre-topology pipeline.
+    num_hosts: int = 1
+    # Mesh axis name of the inter-domain (DCN) axis; the intra-domain
+    # axis reuses MeshConfig.axis_name ("ranks").
+    hosts_axis: str = "hosts"
+    # Wire format of the inter-domain (DCN) hop (docs/PERF.md "Wire
+    # formats" — same codec family as CompositeConfig.wire, applied to
+    # the partially-composited column blocks that cross DCN): "f32" is
+    # bit-exact (the parity contract); "qpack8" is the recommended
+    # production setting on bandwidth-starved DCN (4x fewer bytes, PSNR
+    # floors tested). The intra-domain ICI hop keeps composite.wire.
+    dcn_wire: str = "f32"
+
+    def __post_init__(self):
+        if self.domain_size < 0:
+            raise ValueError(f"domain_size must be >= 0 (0 = auto), "
+                             f"got {self.domain_size}")
+        if self.num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, "
+                             f"got {self.num_hosts}")
+        if not self.hosts_axis:
+            raise ValueError("hosts_axis must be a non-empty axis name")
+        if self.dcn_wire not in ("f32", "bf16", "qpack8"):
+            raise ValueError(f"dcn_wire must be 'f32', 'bf16' or "
+                             f"'qpack8', got {self.dcn_wire!r}")
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh / parallelism settings (replaces rank/commSize fields the
     reference received from C++: DistributedVolumes.kt:103-117).
@@ -645,6 +692,7 @@ class FrameworkConfig:
     vdi: VDIConfig = field(default_factory=VDIConfig)
     composite: CompositeConfig = field(default_factory=CompositeConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    topology: TopologyConfig = field(default_factory=TopologyConfig)
     sim: SimConfig = field(default_factory=SimConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)

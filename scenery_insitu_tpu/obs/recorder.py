@@ -124,6 +124,18 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                     "last-good frame until frames resume",
     "io.vdi_codec": "zstd codec unavailable; VDI IO degrades to stdlib "
                     "zlib",
+    "multihost.connect": "multihost.initialize could not reach the "
+                         "coordinator on an attempt; retrying on the "
+                         "bounded backoff ladder instead of hanging "
+                         "the fleet silently",
+    "multihost.host_down": "hierarchical head assembly: a host's domain "
+                           "partial never arrived; the column block "
+                           "composites without its slab content "
+                           "(degraded), the frame still ships",
+    "multihost.transport": "host gathers route through the coordinator "
+                           "KV store because this backend cannot run "
+                           "cross-process device collectives (the "
+                           "multi-process CPU harness)",
     "occupancy.k_budget": "occupancy K budgets requested where no "
                           "pyramid/adaptive threshold exists; static "
                           "budgets run",
@@ -187,6 +199,10 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                        "dropped; the drain keeps going",
     "sim.stencil_schedule": "Mosaic rejected every probed stencil "
                             "schedule candidate for this grid/T",
+    "topology.hier": "a hierarchical topology knob is inert on this "
+                     "configuration (one host, or a mode with no "
+                     "two-level composite); the flat single-level "
+                     "path runs",
 }
 
 

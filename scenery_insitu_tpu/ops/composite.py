@@ -60,13 +60,7 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
     if assume_sorted:
         sc, sd = flat_c, flat_d
     else:
-        # Empty slots carry +inf start so they sort to the back.
-        order = jnp.argsort(flat_d[:, 0], axis=0)          # [NK, H, W]
-        sc = jnp.take_along_axis(flat_c, order[:, None], axis=0)
-        sd = jnp.take_along_axis(flat_d, order[:, None], axis=0)
-        # Mask non-live slots to zero alpha (they may carry stale colors).
-        live = jnp.isfinite(sd[:, 0])
-        sc = jnp.where(live[:, None], sc, 0.0)
+        sc, sd = sort_stream(flat_c, flat_d)
 
     k_out = cfg.max_output_supersegments
 
@@ -90,6 +84,24 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
         return VDI(color, depth)
 
     return resegment_stream(sc, sd, cfg, gap_eps)
+
+
+def sort_stream(flat_c: jnp.ndarray, flat_d: jnp.ndarray):
+    """Per-pixel depth sort + stale-color masking of a stacked segment
+    stream — the pre-fold half of ``composite_vdis``, shared with the
+    hierarchical composite (parallel/hier.py), whose intra-domain
+    accumulator is exactly this sorted masked stream before the
+    once-at-the-top re-segmentation.
+
+    ``flat_c`` f32[M, 4, H, W], ``flat_d`` f32[M, 2, H, W] → the same
+    shapes sorted by start depth per pixel (empty slots carry +inf start
+    so they sort to the back) with non-live slots' colors zeroed (they
+    may carry stale payloads)."""
+    order = jnp.argsort(flat_d[:, 0], axis=0)              # [M, H, W]
+    sc = jnp.take_along_axis(flat_c, order[:, None], axis=0)
+    sd = jnp.take_along_axis(flat_d, order[:, None], axis=0)
+    live = jnp.isfinite(sd[:, 0])
+    return jnp.where(live[:, None], sc, 0.0), sd
 
 
 def resegment_stream(sc: jnp.ndarray, sd: jnp.ndarray,

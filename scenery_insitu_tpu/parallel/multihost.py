@@ -44,13 +44,56 @@ from scenery_insitu_tpu.parallel.mesh import DEFAULT_AXIS
 
 
 def initialize(coordinator_address: str, num_processes: int,
-               process_id: int) -> None:
-    """≅ MPI_Init. Call before any other JAX use on every process."""
+               process_id: int, timeout_s: float = 300.0,
+               attempt_timeout_s: float = 60.0, fault=None) -> None:
+    """≅ MPI_Init. Call before any other JAX use on every process.
+
+    Wrapped in the bounded-backoff ladder of ``utils/retry.Backoff``
+    (docs/ROBUSTNESS.md "Liveness supervision"): a coordinator that is
+    still starting, a not-yet-scheduled peer or a transient DCN blip no
+    longer hangs the fleet silently — each attempt gets
+    ``attempt_timeout_s``, every retry lands on the fallback ledger as
+    ``multihost.connect``, and the whole ladder gives up (re-raising the
+    last error) after ``timeout_s``. ``fault`` (a config.FaultConfig)
+    supplies the backoff base/cap; None uses the retry defaults."""
+    import time
+
     import jax
 
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    from scenery_insitu_tpu import obs as _obs
+    from scenery_insitu_tpu.utils.retry import Backoff
+
+    bo = (Backoff(fault.backoff_base_s, fault.backoff_cap_s)
+          if fault is not None else Backoff())
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while True:
+        budget = deadline - time.monotonic()
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=max(
+                    1, int(min(attempt_timeout_s, max(budget, 1.0)))))
+            return
+        except Exception as e:
+            try:    # clear any half-initialized client before retrying
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            attempt += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"multihost.initialize: process {process_id} could "
+                    f"not reach the coordinator at "
+                    f"{coordinator_address} within {timeout_s:.0f}s "
+                    f"({attempt} attempts)") from e
+            _obs.degrade("multihost.connect", "first-attempt connect",
+                         f"retry (attempt {attempt})",
+                         f"{type(e).__name__}: {e}", warn=False)
+            time.sleep(min(bo.next_delay(), max(0.0, remaining)))
 
 
 def global_mesh(axis_name: str = DEFAULT_AXIS):
@@ -73,23 +116,133 @@ def shard_global(local_block: np.ndarray, mesh, axis_name: str = DEFAULT_AXIS
     return jax.make_array_from_process_local_data(sharding, local_block)
 
 
-def _allgather_blobs(blob: bytes):
-    """Padded-uint8 allgather of one variable-length blob per process:
-    returns (blobs [P, 1, maxlen], lengths [P, 1]) — the shared
-    transport of the compressed VDI gather and the obs-event merge."""
+def _kv_client():
+    """The coordination-service key-value client every jax.distributed
+    process holds — the host-side DCN side channel (endpoint exchange,
+    barriers, and the blob-allgather fallback below)."""
+    import jax
+
+    client = jax._src.distributed.global_state.client
+    if client is None:
+        raise RuntimeError("jax.distributed is not initialized — the "
+                           "coordinator KV store only exists multi-process")
+    return client
+
+
+def kv_put_bytes(key: str, value: bytes) -> None:
+    """Publish a small blob under ``key`` in the coordinator KV store
+    (base64-string fallback where the bytes API is missing)."""
+    client = _kv_client()
+    if hasattr(client, "key_value_set_bytes"):
+        client.key_value_set_bytes(key, value)
+    else:
+        import base64
+
+        client.key_value_set(key, base64.b64encode(value).decode())
+
+
+def kv_get_bytes(key: str, timeout_ms: int = 60_000) -> bytes:
+    """Blocking fetch of a `kv_put_bytes` blob (waits for the key)."""
+    client = _kv_client()
+    if hasattr(client, "blocking_key_value_get_bytes"):
+        return client.blocking_key_value_get_bytes(key, timeout_ms)
+    import base64
+
+    return base64.b64decode(client.blocking_key_value_get(key, timeout_ms))
+
+
+def barrier(name: str, timeout_ms: int = 60_000) -> None:
+    """Coordination-service barrier across every process (≅ MPI_Barrier
+    on the host plane — no device collective, works on any backend)."""
+    _kv_client().wait_at_barrier(name, timeout_ms)
+
+
+_KV_AG_SEQ = [0]          # collective call counter (same order everywhere)
+
+
+def _device_collectives_ok() -> bool:
+    """Can this runtime run cross-process DEVICE collectives? The CPU
+    backend cannot ("Multiprocess computations aren't implemented"), so
+    multi-process CPU runs — the CI harness, testing/multiproc.py —
+    route host gathers through the coordinator KV store instead."""
+    import jax
+
+    return jax.process_count() == 1 or jax.default_backend() != "cpu"
+
+
+def _allgather_blobs(blob: bytes, timeout_ms: int = 120_000):
+    """Allgather of one variable-length blob per process: returns
+    (blobs [P, 1, maxlen], lengths [P, 1]) — the shared transport of the
+    compressed VDI gather and the obs-event merge, and the explicit DCN
+    hop of the host path (every byte is counted on the
+    ``dcn_bytes_sent`` / ``dcn_bytes_received`` obs counters, the hop
+    spans as ``dcn_allgather`` — docs/OBSERVABILITY.md).
+
+    Transport: a padded-uint8 ``process_allgather`` over devices where
+    the backend supports cross-process collectives; on a multi-process
+    CPU backend it degrades (ledgered ``multihost.transport``) to the
+    coordinator KV store — same wire contract, pure host plane."""
+    from scenery_insitu_tpu import obs as _obs
+
+    rec = _obs.get_recorder()
+    rec.count("dcn_bytes_sent", len(blob))
+    if not _device_collectives_ok():
+        import jax
+
+        _obs.degrade(
+            "multihost.transport", "device-allgather", "coordinator-kv",
+            "this backend cannot run cross-process device collectives; "
+            "host gathers ride the coordination-service KV store",
+            warn=False)
+        nproc = jax.process_count()
+        pid = jax.process_index()
+        seq = _KV_AG_SEQ[0]
+        _KV_AG_SEQ[0] += 1
+        with rec.span("dcn_allgather", transport="kv", seq=seq):
+            kv_put_bytes(f"sitpu/ag/{seq}/{pid}", blob)
+            # bounded KV footprint over long runs: retire our own blob
+            # from TWO collective generations back — any process at call
+            # s has completed call s-1's gets, and it could only start
+            # call s-1 after finishing call s-2's gets, so no reader can
+            # still need a seq-2 key (best-effort: old jax clients lack
+            # key_value_delete; the window stays 2 entries either way)
+            if seq >= 2:
+                try:
+                    _kv_client().key_value_delete(
+                        f"sitpu/ag/{seq - 2}/{pid}")
+                except Exception:  # sitpu-lint: disable=SITPU-LEDGER — cleanup of an already-consumed key; nothing degrades
+                    pass
+            parts = []
+            for p in range(nproc):
+                parts.append(blob if p == pid else kv_get_bytes(
+                    f"sitpu/ag/{seq}/{p}", timeout_ms))
+        maxlen = max(len(b) for b in parts)
+        blobs = np.zeros((nproc, 1, max(maxlen, 1)), np.uint8)
+        lengths = np.zeros((nproc, 1), np.int64)
+        for p, b in enumerate(parts):
+            blobs[p, 0, :len(b)] = np.frombuffer(b, np.uint8)
+            lengths[p, 0] = len(b)
+            if p != pid:
+                rec.count("dcn_bytes_received", len(b))
+        return blobs, lengths
+
     from jax.experimental import multihost_utils
 
     ln = np.zeros((1,), np.int64)
     ln[0] = len(blob)
-    # normalize to [P, 1] / [P, 1, maxlen]: single-process allgather
-    # returns the input without a leading process axis
-    lengths = np.asarray(
-        multihost_utils.process_allgather(ln)).reshape(-1, 1)
-    maxlen = int(lengths.max())
-    buf = np.zeros((1, maxlen), np.uint8)
-    buf[0, :len(blob)] = np.frombuffer(blob, np.uint8)
-    blobs = np.asarray(
-        multihost_utils.process_allgather(buf)).reshape(-1, 1, maxlen)
+    with rec.span("dcn_allgather", transport="device"):
+        # normalize to [P, 1] / [P, 1, maxlen]: single-process allgather
+        # returns the input without a leading process axis
+        lengths = np.asarray(
+            multihost_utils.process_allgather(ln)).reshape(-1, 1)
+        maxlen = int(lengths.max())
+        buf = np.zeros((1, maxlen), np.uint8)
+        buf[0, :len(blob)] = np.frombuffer(blob, np.uint8)
+        blobs = np.asarray(
+            multihost_utils.process_allgather(buf)).reshape(-1, 1, maxlen)
+    received = int(lengths.sum() - len(blob))
+    if received > 0:
+        rec.count("dcn_bytes_received", received)
     return blobs, lengths
 
 
@@ -133,10 +286,15 @@ def gather_vdi_tiles(vdi, codec: str = "zstd"):
     ch_d = vdi.depth.shape[1]
 
     def tiles():
+        from scenery_insitu_tpu import obs as _obs
+
+        rec = _obs.get_recorder()
         col0 = 0
         for p in range(nproc):
-            raw = decompress(bytes(blobs[p, 0, :int(lengths[p, 0])]),
-                             codec)
+            with rec.span("dcn_decompress", source_rank=p,
+                          bytes=int(lengths[p, 0])):
+                raw = decompress(bytes(blobs[p, 0, :int(lengths[p, 0])]),
+                                 codec)
             arr = np.frombuffer(raw, np.float32)
             wseg = arr.size // (k * (ch + ch_d) * h)
             nc = k * ch * h * wseg

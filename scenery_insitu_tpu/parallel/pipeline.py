@@ -97,6 +97,7 @@ from scenery_insitu_tpu.ops.composite import composite_plain, composite_vdis
 from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
 from scenery_insitu_tpu.parallel.mesh import halo_exchange_z, reslab_z
+from scenery_insitu_tpu.parallel.topology import resolve_mesh_topology
 
 from scenery_insitu_tpu.utils.compat import shard_map
 
@@ -229,18 +230,13 @@ def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
     slots' payloads are masked identically in both paths.
     """
     from scenery_insitu_tpu import obs as _obs
-    from scenery_insitu_tpu.ops.composite import (merge_vdis_pairwise,
-                                                  modeled_exchange_traffic,
-                                                  resegment_stream)
+    from scenery_insitu_tpu.ops.composite import (modeled_exchange_traffic,
+                                                  resegment_stream,
+                                                  sort_stream)
 
     k = color.shape[0]
     h, w = color.shape[-2], color.shape[-1]
-    cap = int(cfg.ring_slots) or None
-    if cap is not None and cap < k:
-        raise ValueError(
-            f"ring_slots={cap} is below the per-rank fragment size K={k} "
-            f"— the accumulator could not even hold one incoming fragment "
-            f"(use 0 for lossless, or >= K, e.g. 2*K)")
+    cap = _ring_cap(cfg, k)
 
     # host-side build markers (this runs at trace time, once per compiled
     # step): the per-hop events give the trace one entry per ring step
@@ -257,26 +253,55 @@ def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
     # replaces the all_to_all path's N·K-wide post-exchange sort (the VDI
     # convention already promises front-to-back live slots; the sort makes
     # the merge's sorted-input precondition unconditional)
-    order = jnp.argsort(depth[:, 0], axis=0)
-    color = jnp.take_along_axis(color, order[:, None], axis=0)
-    depth = jnp.take_along_axis(depth, order[:, None], axis=0)
-    color = jnp.where(jnp.isfinite(depth[:, 0])[:, None], color, 0.0)
+    color, depth = sort_stream(color, depth)
+    acc_c, acc_d = _ring_accumulate(color, depth, n, axis_name, cfg.wire,
+                                    cap)
+    return resegment_stream(acc_c, acc_d, cfg, gap_eps)
 
-    # wire encode ONCE on the local fragment; every hop ships the narrow
-    # encoding and decodes on receive (docs/PERF.md "Wire formats"). The
-    # own block round-trips the codec too, so the accumulator sees the
-    # same quantization the all_to_all path applies to every fragment —
-    # both schedules degrade identically under a lossy wire. Quantizers
-    # are monotone, so the pre-sorted stream decodes sorted (the
-    # pairwise-merge precondition). f32 keeps the pre-wire ops exactly.
+
+def _ring_cap(cfg, k: int):
+    """Validated per-pixel accumulator cap of a ring merge (None =
+    lossless): ring_slots must at least hold one incoming fragment."""
+    cap = int(cfg.ring_slots) or None
+    if cap is not None and cap < k:
+        raise ValueError(
+            f"ring_slots={cap} is below the per-rank fragment size K={k} "
+            f"— the accumulator could not even hold one incoming fragment "
+            f"(use 0 for lossless, or >= K, e.g. 2*K)")
+    return cap
+
+
+def _ring_accumulate(color: jnp.ndarray, depth: jnp.ndarray, n: int,
+                     axis_name, wire: str, cap,
+                     hop_counter: str = "ring_steps_built",
+                     hop_event: str = "ring_step"):
+    """The pipelined ring-merge core, shared by the single-level ring
+    exchange above and the hierarchical composite's inter-domain (DCN)
+    hop (parallel/hier.py): circulate each rank's column blocks of a
+    per-pixel SORTED, empty-masked fragment ``[K, ...]`` around the
+    ``n``-rank ``axis_name`` ring in n-1 ``ppermute`` hops, folding each
+    arrival into a per-rank sorted accumulator with the pairwise ordered
+    merge. Returns this rank's 1/n column-block accumulator (NOT
+    re-segmented — callers resegment once at the top of their exchange).
+
+    Wire encode runs ONCE on the local fragment; every hop ships the
+    narrow encoding and decodes on receive (docs/PERF.md "Wire
+    formats"). The own block round-trips the codec too, so the
+    accumulator sees the same quantization whichever schedule ran —
+    and the quantizers are monotone, so the pre-sorted stream decodes
+    sorted (the pairwise-merge precondition). f32 inserts zero ops."""
+    from scenery_insitu_tpu import obs as _obs
     from scenery_insitu_tpu.ops import wire as _wire
-    if cfg.wire == "f32":
+    from scenery_insitu_tpu.ops.composite import merge_vdis_pairwise
+
+    rec = _obs.get_recorder()
+    if wire == "f32":
         enc_c, enc_d, scale = color, depth, None
     else:
-        enc_c, enc_d, scale = _wire.encode_fragment(color, depth, cfg.wire)
+        enc_c, enc_d, scale = _wire.encode_fragment(color, depth, wire)
 
     def dec(c, d, sc):
-        return _wire.decode_fragment(c, d, sc, cfg.wire)
+        return _wire.decode_fragment(c, d, sc, wire)
 
     blk_c = _column_blocks(enc_c, n)                  # [n, K, ..., H, W/n]
     blk_d = _column_blocks(enc_d, n)
@@ -293,22 +318,30 @@ def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
         recv_d = jax.lax.ppermute(send_d, axis_name, perm)
         recv_s = (jax.lax.ppermute(scale, axis_name, perm)
                   if scale is not None else None)
-        rec.count("ring_steps_built")
-        rec.event("ring_step", step=s, hops=s, frag_bytes=frag_bytes,
-                  wire=cfg.wire)
+        rec.count(hop_counter)
+        rec.event(hop_event, step=s, hops=s, frag_bytes=frag_bytes,
+                  wire=wire)
         mc, md = dec(recv_c, recv_d, recv_s)
         acc_c, acc_d = merge_vdis_pairwise(acc_c, acc_d, mc, md,
                                            k_cap=cap)
-    return resegment_stream(acc_c, acc_d, cfg, gap_eps)
+    return acc_c, acc_d
 
 
 def _composite_exchanged(color: jnp.ndarray, depth: jnp.ndarray,
-                         n: int, axis_name: str, comp_cfg):
+                         n: int, axis_name: str, comp_cfg, topo=None):
     """Sort-last exchange + composite under the configured schedule
     (CompositeConfig.exchange). Runs inside shard_map; returns the
     composited VDI of this rank's column block. n == 1 always takes the
     all_to_all path (both schedules are the identity exchange there, and
-    it keeps the single-VDI fast path of `composite_vdis`)."""
+    it keeps the single-VDI fast path of `composite_vdis`). ``topo``
+    (a parallel/topology.Topology) switches to the TWO-LEVEL composite:
+    intra-domain exchange over the ranks sub-axis (ICI), inter-domain
+    merge over the hosts sub-axis (DCN), re-segmented once at the top
+    (parallel/hier.py) — parity-gated against this flat path."""
+    if topo is not None:
+        from scenery_insitu_tpu.parallel.hier import hier_composite_vdi
+
+        return hier_composite_vdi(color, depth, topo, comp_cfg)
     if comp_cfg.exchange == "ring" and n > 1:
         return _ring_exchange_composite(color, depth, n, axis_name,
                                         comp_cfg)
@@ -385,7 +418,8 @@ def _wave_build_marker(n: int, t: int, k: int, h: int, w: int, k_out: int,
 
 
 def _composite_exchanged_waves(color: jnp.ndarray, depth: jnp.ndarray,
-                               n: int, axis_name: str, comp_cfg) -> VDI:
+                               n: int, axis_name: str, comp_cfg,
+                               topo=None) -> VDI:
     """Tile-wave exchange + composite of an ALREADY-generated full-frame
     fragment (the gather-engine waves path — the march was monolithic,
     so the pipeline overlaps each wave's collective with the next wave's
@@ -408,7 +442,8 @@ def _composite_exchanged_waves(color: jnp.ndarray, depth: jnp.ndarray,
                 _slicer.wave_cols(depth, n, t, wv)), None
 
     def compose(fr):
-        out = _composite_exchanged(fr[0], fr[1], n, axis_name, comp_cfg)
+        out = _composite_exchanged(fr[0], fr[1], n, axis_name, comp_cfg,
+                                   topo=topo)
         return out.color, out.depth
 
     (oc, od), _ = _wave_pipeline(t, march, compose)
@@ -416,7 +451,8 @@ def _composite_exchanged_waves(color: jnp.ndarray, depth: jnp.ndarray,
 
 
 def _composite_exchanged_sched(color: jnp.ndarray, depth: jnp.ndarray,
-                               n: int, axis_name: str, comp_cfg) -> VDI:
+                               n: int, axis_name: str, comp_cfg,
+                               topo=None) -> VDI:
     """Schedule dispatcher of the sort-last exchange + composite
     (CompositeConfig.schedule): "frame" = the monolithic chain above,
     "waves" = the per-column-block-wave scan. A single-rank mesh
@@ -425,13 +461,14 @@ def _composite_exchanged_sched(color: jnp.ndarray, depth: jnp.ndarray,
     if comp_cfg.schedule == "waves":
         if n > 1:
             return _composite_exchanged_waves(color, depth, n, axis_name,
-                                              comp_cfg)
+                                              comp_cfg, topo=topo)
         from scenery_insitu_tpu import obs as _obs
 
         _obs.degrade("composite.schedule", "waves", "frame",
                      "single-rank mesh has no exchange to pipeline",
                      warn=False)
-    return _composite_exchanged(color, depth, n, axis_name, comp_cfg)
+    return _composite_exchanged(color, depth, n, axis_name, comp_cfg,
+                                topo=topo)
 
 
 def _resolve_waves(comp_cfg, n: int, width: int, slicer_mod=None) -> bool:
@@ -507,8 +544,9 @@ def distributed_initial_reuse_mxu(mesh: Mesh, tf: TransferFunction,
 
     vdi_cfg = vdi_cfg or VDIConfig()
     comp_cfg = comp_cfg or CompositeConfig()
-    axis = axis_name or mesh.axis_names[0]
-    n = mesh.shape[axis]
+    # hierarchical meshes seed over the flat axis view (the carry is
+    # per-rank state; the composite levels never see it)
+    axis, n, _ = resolve_mesh_topology(mesh, axis_name)
     plan = _resolve_plan(comp_cfg, n, plan)
 
     def seed(local_data, origin, spacing, cam: Camera):
@@ -578,7 +616,9 @@ def _resolve_plan(comp_cfg, n: int, plan, min_halo: int = 1):
 
 
 def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
-                         n: int, axis_name: str, wire: str = "f32"):
+                         n: int, axis_name: str, wire: str = "f32",
+                         hop_counter: str = "ring_steps_built",
+                         build_counter: str = "ring_exchange_builds"):
     """Ring schedule for the plain-image exchange: n-1 single-fragment
     ppermute hops (pipelined like the VDI ring), then the stacked
     fragments are rolled back into SOURCE-RANK order so the downstream
@@ -604,7 +644,7 @@ def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
     blk_d = _column_blocks(enc_d, n)                  # [n, H, W/n]
     r = jax.lax.axis_index(axis_name)
     rec = _obs.get_recorder()
-    rec.count("ring_exchange_builds")
+    rec.count(build_counter)
     own_i, own_d = dec(_take_block(blk_i, r), _take_block(blk_d, r), scale)
     frags_i = [own_i]
     frags_d = [own_d]
@@ -619,7 +659,7 @@ def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
         di, dd = dec(recv_i, recv_d, recv_s)
         frags_i.append(di)
         frags_d.append(dd)
-        rec.count("ring_steps_built")
+        rec.count(hop_counter)
     stacked_i = jnp.stack(frags_i)          # arrival order: r, r+1, ...
     stacked_d = jnp.stack(frags_d)
     # out[i] = stacked[(i - r) % n] = source rank i
@@ -628,10 +668,18 @@ def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
 
 def _composite_plain_exchanged(image: jnp.ndarray, depth: jnp.ndarray,
                                n: int, axis_name: str, background,
-                               exchange: str, wire: str = "f32"):
+                               exchange: str, wire: str = "f32",
+                               topo=None):
     """Plain-image exchange + nearest-first composite under the configured
     schedule (`exchange` ∈ {"all_to_all", "ring"}) and wire format
-    (`wire` ∈ {"f32", "bf16", "qpack8"})."""
+    (`wire` ∈ {"f32", "bf16", "qpack8"}). ``topo`` switches to the
+    two-level plain composite (parallel/hier.py): domain partials over
+    ICI, nearest-first merge of the partials over DCN."""
+    if topo is not None:
+        from scenery_insitu_tpu.parallel.hier import hier_composite_plain
+
+        return hier_composite_plain(image, depth, topo, background,
+                                    exchange, wire)
     if exchange == "ring" and n > 1:
         images, depths = _ring_exchange_plain(image, depth, n, axis_name,
                                               wire)
@@ -651,7 +699,7 @@ def _composite_plain_exchanged(image: jnp.ndarray, depth: jnp.ndarray,
 def _composite_plain_waves(image: jnp.ndarray, depth: jnp.ndarray,
                            n: int, axis_name: str, background,
                            exchange: str, wire: str, wave_tiles: int,
-                           march_wave=None) -> jnp.ndarray:
+                           march_wave=None, topo=None) -> jnp.ndarray:
     """Tile-wave plain-image exchange + composite. ``march_wave(w, _) ->
     ((image_w, depth_w), _)`` optionally RENDERS each wave's column
     blocks (the MXU engine's tile-scoped `render_slices`) so the wave's
@@ -675,7 +723,8 @@ def _composite_plain_waves(image: jnp.ndarray, depth: jnp.ndarray,
 
     def compose(fr):
         return (_composite_plain_exchanged(fr[0], fr[1], n, axis_name,
-                                           background, exchange, wire),)
+                                           background, exchange, wire,
+                                           topo=topo),)
 
     (img,), _ = _wave_pipeline(t, march_wave, compose)
     return _wave_assemble(img)
@@ -687,17 +736,22 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
                          comp_cfg: Optional[CompositeConfig] = None,
                          max_steps: int = 256,
                          axis_name: Optional[str] = None,
-                         plan=None):
+                         plan=None, topology=None):
     """Build the jitted distributed VDI render step.
 
     Returns ``f(vol_data f32[D, H, W] (z-sharded), origin f32[3],
     spacing f32[3], cam Camera) -> VDI`` whose color/depth are W-sharded
     global arrays ([K_out, 4, height, width] / [K_out, 2, height, width]).
+
+    ``topology`` (a config.TopologyConfig; docs/MULTIHOST.md) selects
+    the two-level composite on a hierarchical ``(hosts, ranks)`` mesh —
+    generation and halo exchange run over the flat axis view unchanged,
+    the sort-last composite splits into intra-domain (ICI) + inter-domain
+    (DCN) levels. None on a flat mesh is exactly the single-level step.
     """
     vdi_cfg = vdi_cfg or VDIConfig()
     comp_cfg = comp_cfg or CompositeConfig()
-    axis = axis_name or mesh.axis_names[0]
-    n = mesh.shape[axis]
+    axis, n, topo = resolve_mesh_topology(mesh, axis_name, topology)
     if width % n:
         raise ValueError(f"width {width} not divisible by mesh size {n}")
     if comp_cfg.schedule == "waves" and n > 1:
@@ -725,10 +779,11 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
                               clip_max=cmax, sample_min=smin,
                               sample_max=smax)
         return _composite_exchanged_sched(vdi.color, vdi.depth, n, axis,
-                                          comp_cfg)
+                                          comp_cfg, topo=topo)
 
+    w_axis = axis if topo is None else topo.out_axis
     spec_vol = P(axis, None, None)
-    spec_out = VDI(P(None, None, None, axis), P(None, None, None, axis))
+    spec_out = VDI(P(None, None, None, w_axis), P(None, None, None, w_axis))
     f = shard_map(step, mesh=mesh,
                   in_specs=(spec_vol, P(), P(), P()),
                   out_specs=spec_out, check_vma=False)
@@ -1016,7 +1071,7 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
 def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
                              spec, tf, vdi_cfg, comp_cfg, axis, n,
                              threshold=None, plan=None, reuse=None,
-                             reuse_tol: float = 0.0):
+                             reuse_tol: float = 0.0, topo=None):
     """The tile-wave twin of `_mxu_rank_generate` + `_composite_exchanged`
     (CompositeConfig.schedule == "waves"; docs/PERF.md "Tile waves"):
     instead of one whole-frame march followed by one exchange, each rank
@@ -1109,7 +1164,8 @@ def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
         return (cw, dw), (thr_full, acc_c, acc_d)
 
     def compose(fr):
-        out = _composite_exchanged(fr[0], fr[1], n, axis, comp_cfg)
+        out = _composite_exchanged(fr[0], fr[1], n, axis, comp_cfg,
+                                   topo=topo)
         return out.color, out.depth
 
     carry0 = (threshold if reuse is None else
@@ -1136,7 +1192,8 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
                              spec, vdi_cfg: Optional[VDIConfig] = None,
                              comp_cfg: Optional[CompositeConfig] = None,
                              axis_name: Optional[str] = None,
-                             plan=None, reuse_tol: float = 0.0):
+                             plan=None, reuse_tol: float = 0.0,
+                             topology=None):
     """Distributed sort-last VDI pipeline on the MXU slice-march engine
     (ops/slicer.py) — generation runs as banded-matmul slice resampling
     instead of per-ray gathers; the rest of the chain (width-axis column
@@ -1159,11 +1216,12 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
                            temporal=False, plan=plan,
-                           reuse_tol=reuse_tol)
+                           reuse_tol=reuse_tol, topology=topology)
 
 
 def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                    temporal: bool, plan=None, reuse_tol: float = 0.0):
+                    temporal: bool, plan=None, reuse_tol: float = 0.0,
+                    topology=None):
     """Shared builder of the MXU sort-last step (generate → column
     exchange under ``comp_cfg.exchange`` → composite), with or without
     carried temporal threshold state threaded through.
@@ -1180,8 +1238,7 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
 
     vdi_cfg = vdi_cfg or VDIConfig()
     comp_cfg = comp_cfg or CompositeConfig()
-    axis = axis_name or mesh.axis_names[0]
-    n = mesh.shape[axis]
+    axis, n, topo = resolve_mesh_topology(mesh, axis_name, topology)
     if spec.ni % n:
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
@@ -1194,17 +1251,19 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
             out, meta, _, thr2, ru2 = _mxu_rank_generate_waves(
                 local_data, origin, spacing, cam, slicer, spec, tf,
                 vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan,
-                reuse=ru, reuse_tol=reuse_tol)
+                reuse=ru, reuse_tol=reuse_tol, topo=topo)
             return out, meta, thr2, ru2
         vdi, meta, _, thr2, ru2 = _mxu_rank_generate(
             local_data, origin, spacing, cam, slicer, spec, tf, vdi_cfg,
             axis, n, threshold=thr, comp_cfg=comp_cfg, plan=plan,
             reuse=ru, reuse_tol=reuse_tol)
         return (_composite_exchanged(vdi.color, vdi.depth, n, axis,
-                                     comp_cfg), meta, thr2, ru2)
+                                     comp_cfg, topo=topo), meta, thr2,
+                ru2)
 
+    w_axis = axis if topo is None else topo.out_axis
     spec_vol = P(axis, None, None)
-    out_vdi = VDI(P(None, None, None, axis), P(None, None, None, axis))
+    out_vdi = VDI(P(None, None, None, w_axis), P(None, None, None, w_axis))
     out_meta = VDIMetadata(*(P() for _ in VDIMetadata._fields))
 
     if temporal and reuse:
@@ -1279,8 +1338,7 @@ def distributed_initial_threshold_mxu(mesh: Mesh, tf: TransferFunction,
     from scenery_insitu_tpu.ops import slicer
 
     vdi_cfg = vdi_cfg or VDIConfig()
-    axis = axis_name or mesh.axis_names[0]
-    n = mesh.shape[axis]
+    axis, n, _ = resolve_mesh_topology(mesh, axis_name)
     # the seeding march must run the SAME render decomposition the step
     # it seeds will march (no CompositeConfig here, so the mode is
     # implied by the plan itself)
@@ -1306,7 +1364,8 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
                                       comp_cfg: Optional[CompositeConfig]
                                       = None,
                                       axis_name: Optional[str] = None,
-                                      plan=None, reuse_tol: float = 0.0):
+                                      plan=None, reuse_tol: float = 0.0,
+                                      topology=None):
     """`distributed_vdi_step_mxu` with carried per-rank temporal threshold
     state (adaptive_mode="temporal": ONE march per rank per frame instead
     of counting + write — see slicer.generate_vdi_mxu_temporal).
@@ -1320,7 +1379,8 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
     return (see `distributed_vdi_step_mxu`).
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                           temporal=True, plan=plan, reuse_tol=reuse_tol)
+                           temporal=True, plan=plan, reuse_tol=reuse_tol,
+                           topology=topology)
 
 
 def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
@@ -1330,7 +1390,7 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
                                 colormap: str = "jet",
                                 axis_name: Optional[str] = None,
                                 temporal: bool = False,
-                                plan=None):
+                                plan=None, topology=None):
     """Distributed hybrid volume+particle frame (BASELINE.md Config 5):
     z-sharded volume through the sort-last MXU VDI chain, N-sharded
     tracers through the sort-first splat chain (per-rank z-buffer,
@@ -1358,8 +1418,7 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
 
     vdi_cfg = vdi_cfg or VDIConfig()
     comp_cfg = comp_cfg or CompositeConfig()
-    axis = axis_name or mesh.axis_names[0]
-    n = mesh.shape[axis]
+    axis, n, topo = resolve_mesh_topology(mesh, axis_name, topology)
     if spec.ni % n:
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
@@ -1377,22 +1436,26 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
             # same block the frame schedule composites
             comp, meta, axcam, thr2, _ = _mxu_rank_generate_waves(
                 local_data, origin, spacing, cam, slicer, spec, tf,
-                vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan)
+                vdi_cfg, comp_cfg, axis, n, threshold=thr, plan=plan,
+                topo=topo)
         else:
             vdi, meta, axcam, thr2, _ = _mxu_rank_generate(
                 local_data, origin, spacing, cam, slicer, spec, tf,
                 vdi_cfg, axis, n, threshold=thr, comp_cfg=comp_cfg,
                 plan=plan)
             comp = _composite_exchanged(vdi.color, vdi.depth, n, axis,
-                                        comp_cfg)          # [Ko,·,Nj,Ni/n]
+                                        comp_cfg, topo=topo)
+            # [Ko, ·, Nj, Ni/n]
 
         # sort-first particle pass on the virtual camera's rays
         sp = sort_first_splat(tr_pos, tr_vel, axis, spec.ni, spec.nj,
                               radius, stamp, colormap,
                               view=axcam.view, proj=axcam.proj)
 
-        # my column block of the (replicated) particle layer
-        r = jax.lax.axis_index(axis)
+        # my column block of the (replicated) particle layer — under a
+        # hierarchical topology the composite hands this rank the block
+        # at ranks-major flat position (topology.Topology.out_axis)
+        r = _out_block_index(axis, topo)
         wb = spec.ni // n
         img_b = jax.lax.dynamic_slice_in_dim(sp.image, r * wb, wb, axis=2)
         dep_b = jax.lax.dynamic_slice_in_dim(sp.depth, r * wb, wb, axis=1)
@@ -1400,6 +1463,7 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
         return hyb, meta, thr2
 
     from scenery_insitu_tpu.core.vdi import VDIMetadata
+    w_axis = axis if topo is None else topo.out_axis
     out_meta = VDIMetadata(*(P() for _ in VDIMetadata._fields))
     in_base = (P(axis, None, None), P(), P(), P(axis, None), P(axis, None),
                P())
@@ -1414,7 +1478,8 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
             return (img, meta), thr2
 
         f = shard_map(step, mesh=mesh, in_specs=in_base + (thr_spec,),
-                      out_specs=((P(None, None, axis), out_meta), thr_spec),
+                      out_specs=((P(None, None, w_axis), out_meta),
+                                 thr_spec),
                       check_vma=False)
     else:
         def step(local_data, origin, spacing, tr_pos, tr_vel, cam: Camera):
@@ -1423,9 +1488,20 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
             return img, meta
 
         f = shard_map(step, mesh=mesh, in_specs=in_base,
-                      out_specs=(P(None, None, axis), out_meta),
+                      out_specs=(P(None, None, w_axis), out_meta),
                       check_vma=False)
     return jax.jit(f)
+
+
+def _out_block_index(axis, topo):
+    """Traced flat index of this rank's OUTPUT column block: the plain
+    axis index on flat meshes; on hierarchical meshes the two-level
+    composite hands rank (h, d) the block at ranks-major position
+    ``d * H + h`` (topology.Topology.out_axis)."""
+    if topo is None:
+        return jax.lax.axis_index(axis)
+    return (jax.lax.axis_index(topo.ranks_axis) * topo.num_hosts
+            + jax.lax.axis_index(topo.hosts_axis))
 
 
 def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
@@ -1441,7 +1517,7 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                rebalance_min_depth: int = 4,
                                rebalance_quantum: int = 4,
                                temporal_reuse: str = "off",
-                               plan=None):
+                               plan=None, topology=None):
     """Distributed plain-image rendering on the MXU slice-march engine —
     the TPU-fast counterpart of `distributed_plain_step` (the reference's
     non-VDI mode, VolumeRaycaster.comp:94-161 composited by
@@ -1477,8 +1553,7 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
     from scenery_insitu_tpu.ops import slicer
 
     cfg = cfg or RenderConfig()
-    axis = axis_name or mesh.axis_names[0]
-    n = mesh.shape[axis]
+    axis, n, topo = resolve_mesh_topology(mesh, axis_name, topology)
     if spec.ni % n:
         raise ValueError(f"intermediate width {spec.ni} not divisible by "
                          f"mesh size {n}")
@@ -1547,7 +1622,7 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
 
             img = _composite_plain_waves(
                 None, None, n, axis, bg, exchange, wire, wave_tiles,
-                march_wave=march_wave)
+                march_wave=march_wave, topo=topo)
             return img, axcam
         out = slicer.render_slices(vol, tf_r, axcam, spec,
                                    cfg.early_exit_alpha,
@@ -1555,13 +1630,15 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                    step_scale=cfg.step_scale,
                                    w_bounds=w_bounds)
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
-                                          bg, exchange, wire), axcam
+                                          bg, exchange, wire,
+                                          topo=topo), axcam
 
     from scenery_insitu_tpu.ops.slicer import AxisCamera
+    w_axis = axis if topo is None else topo.out_axis
     out_axcam = AxisCamera(*(P() for _ in AxisCamera._fields))
     f = shard_map(step, mesh=mesh,
                   in_specs=(P(axis, None, None), P(), P(), P()),
-                  out_specs=(P(None, None, axis), out_axcam),
+                  out_specs=(P(None, None, w_axis), out_axcam),
                   check_vma=False)
     return jax.jit(f)
 
@@ -1580,7 +1657,7 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            rebalance_min_depth: int = 4,
                            rebalance_quantum: int = 4,
                            temporal_reuse: str = "off",
-                           plan=None):
+                           plan=None, topology=None):
     """Build the jitted distributed plain-image render step (the reference's
     non-VDI mode: VolumeRaycaster + PlainImageCompositor,
     DistributedVolumeRenderer.kt:175-189). Returns ``f(vol_data, origin,
@@ -1591,8 +1668,7 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
     is monolithic, so "waves" pipelines exchange against composite at
     column-block granularity) — see `distributed_plain_step_mxu`."""
     cfg = cfg or RenderConfig(width=width, height=height)
-    axis = axis_name or mesh.axis_names[0]
-    n = mesh.shape[axis]
+    axis, n, topo = resolve_mesh_topology(mesh, axis_name, topology)
     if width % n:
         raise ValueError(f"width {width} not divisible by mesh size {n}")
     knob_cfg = CompositeConfig(schedule=schedule, wave_tiles=wave_tiles,
@@ -1652,13 +1728,15 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
         if waves:
             return _composite_plain_waves(out.image, out.depth, n, axis,
                                           cfg.background, exchange, wire,
-                                          wave_tiles)
+                                          wave_tiles, topo=topo)
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
-                                          cfg.background, exchange, wire)
+                                          cfg.background, exchange, wire,
+                                          topo=topo)
 
+    w_axis = axis if topo is None else topo.out_axis
     f = shard_map(step, mesh=mesh,
                   in_specs=(P(axis, None, None), P(), P(), P()),
-                  out_specs=P(None, None, axis), check_vma=False)
+                  out_specs=P(None, None, w_axis), check_vma=False)
     return jax.jit(f)
 
 

@@ -1,0 +1,186 @@
+"""First-class mesh topology — the scale-out plane (docs/MULTIHOST.md).
+
+The single-domain pipeline's "communicator" is a flat 1-D
+``jax.sharding.Mesh`` over one ICI domain (parallel/mesh.py). This module
+makes the ICI/DCN split a construction-time fact instead of an implicit
+assumption: a ``TopologyConfig(domain_size, num_hosts)`` resolves to a
+2-D ``(hosts, ranks)`` mesh whose *ranks* sub-axis is the fast
+intra-domain (ICI) axis and whose *hosts* sub-axis crosses domains over
+DCN. Devices are laid out hosts-major, so on a real multi-process run
+(``jax.distributed``) each process's local devices land in one domain
+and hosts-axis collectives are exactly the cross-process (DCN) hops.
+
+On a single process the same 2-D mesh over the virtual CPU/TPU device
+list EMULATES the hierarchy — domains become mesh sub-axes — which is
+what lets the two-level composite (parallel/hier.py) run, and be
+parity-gated against the flat composite, in ordinary CI.
+
+The generation side of the pipeline (halo exchange, slab ownership,
+occupancy psums) is topology-agnostic: it addresses the mesh through the
+FLAT axis view ``Topology.flat_axis`` — a ``(hosts, ranks)`` tuple that
+every ``jax.lax`` collective accepts wherever a single axis name goes,
+linearized hosts-major so flat rank ``h * D + d`` owns z-slab
+``h * D + d`` exactly like the 1-D mesh. Only the sort-last composite
+consults the split (parallel/hier.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple, Union
+
+from scenery_insitu_tpu.config import MeshConfig, TopologyConfig
+from scenery_insitu_tpu.parallel.mesh import DEFAULT_AXIS
+
+DEFAULT_HOSTS_AXIS = "hosts"
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+class Topology(NamedTuple):
+    """Resolved mesh topology of a hierarchical (two-level) mesh."""
+
+    num_hosts: int          # ICI domains (DCN endpoints)
+    domain_size: int        # devices per domain
+    hosts_axis: str         # inter-domain (DCN) mesh axis
+    ranks_axis: str         # intra-domain (ICI) mesh axis
+    dcn_wire: str = "f32"   # wire format of the DCN hop
+
+    @property
+    def n_ranks(self) -> int:
+        return self.num_hosts * self.domain_size
+
+    @property
+    def flat_axis(self) -> Tuple[str, str]:
+        """The generation-side flat axis view: collectives over this
+        tuple linearize hosts-major, so flat rank ``h * D + d`` matches
+        the 1-D mesh's rank ordering (z-slab h*D+d)."""
+        return (self.hosts_axis, self.ranks_axis)
+
+    @property
+    def out_axis(self) -> Tuple[str, str]:
+        """Output-sharding axis order of the two-level composite: level
+        1 hands rank ``(h, d)`` column block ``d`` and level 2 sub-block
+        ``h`` within it, so its final columns sit at flat position
+        ``d * H + h`` — the ranks-major traversal."""
+        return (self.ranks_axis, self.hosts_axis)
+
+
+def resolve_topology(cfg: Optional[TopologyConfig], n_devices: int,
+                     ranks_axis: str = DEFAULT_AXIS) -> Optional[Topology]:
+    """Resolve a TopologyConfig against a device count.
+
+    Returns None for flat configurations (``num_hosts == 1`` — today's
+    single-level path, bitwise). A 1-host config that nevertheless sets
+    ``domain_size`` asked for a domain split with nothing to split
+    across: the knob is inert and lands on the fallback ledger
+    (``topology.hier``) instead of being silently ignored.
+
+    ``domain_size`` must divide the participating device count exactly
+    (and ``num_hosts * domain_size`` must equal it) — a hierarchy that
+    does not tile the mesh fails here, at build, not inside a trace.
+    """
+    if cfg is None or cfg.num_hosts == 1:
+        if cfg is not None and cfg.domain_size not in (0, n_devices):
+            from scenery_insitu_tpu import obs as _obs
+
+            _obs.degrade(
+                "topology.hier", f"domain_size={cfg.domain_size}", "flat",
+                "num_hosts=1: a single host has no DCN axis — the "
+                "two-level composite degenerates to the flat path",
+                warn=False)
+        return None
+    h = cfg.num_hosts
+    d = cfg.domain_size or (n_devices // h if n_devices % h == 0 else 0)
+    if d <= 0 or n_devices % d or h * d != n_devices:
+        raise ValueError(
+            f"topology (num_hosts={h}, domain_size={cfg.domain_size}) "
+            f"does not tile {n_devices} devices — domain_size must "
+            f"divide the device count and num_hosts * domain_size must "
+            f"equal it (0 = auto derives {n_devices}/{h})")
+    if cfg.hosts_axis == ranks_axis:
+        raise ValueError(
+            f"hosts_axis {cfg.hosts_axis!r} collides with the ranks "
+            f"axis name — the two mesh levels need distinct axes")
+    return Topology(num_hosts=h, domain_size=d, hosts_axis=cfg.hosts_axis,
+                    ranks_axis=ranks_axis, dcn_wire=cfg.dcn_wire)
+
+
+def make_topology_mesh(topo_cfg: Optional[TopologyConfig] = None,
+                       mesh_cfg: Optional[MeshConfig] = None,
+                       devices: Optional[Sequence] = None):
+    """Build the compositing mesh under a topology — the topology-aware
+    successor of ``mesh.make_mesh`` (which it degenerates to for flat
+    configs). Returns ``(mesh, topo)`` where ``topo`` is None for a flat
+    1-D mesh and a `Topology` for the 2-D ``(hosts, ranks)`` mesh.
+
+    Devices stay in their natural (process-major) order and reshape to
+    ``[num_hosts, domain_size]`` — on a multi-process runtime each
+    process's local devices form one domain, so ranks-axis collectives
+    ride ICI and hosts-axis collectives ride DCN by construction."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    mesh_cfg = mesh_cfg or MeshConfig()
+    devs = list(devices) if devices is not None else jax.devices()
+    if mesh_cfg.num_devices:
+        if mesh_cfg.num_devices > len(devs):
+            raise ValueError(f"requested {mesh_cfg.num_devices} devices, "
+                             f"have {len(devs)}")
+        devs = devs[:mesh_cfg.num_devices]
+    topo = resolve_topology(topo_cfg, len(devs), mesh_cfg.axis_name)
+    if topo is None:
+        from scenery_insitu_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(len(devs), mesh_cfg.axis_name, devices=devs), None
+    grid = np.array(devs).reshape(topo.num_hosts, topo.domain_size)
+    return Mesh(grid, (topo.hosts_axis, topo.ranks_axis)), topo
+
+
+def topology_of(mesh, topology: Optional[TopologyConfig] = None
+                ) -> Optional[Topology]:
+    """Resolved `Topology` of a mesh: None for 1-D (flat) meshes; for a
+    2-D mesh the split is read off the mesh axes themselves, optionally
+    cross-checked against a ``TopologyConfig`` (a config that disagrees
+    with the mesh it is used with is a caller bug, not a silent pick)."""
+    names = mesh.axis_names
+    if len(names) == 1:
+        if topology is not None and topology.num_hosts > 1:
+            raise ValueError(
+                f"topology requests num_hosts={topology.num_hosts} but "
+                f"the mesh is flat 1-D ({names[0]!r}) — build it with "
+                f"topology.make_topology_mesh")
+        return None
+    if len(names) != 2:
+        raise ValueError(f"compositing meshes are 1-D (flat) or 2-D "
+                         f"(hosts, ranks); got axes {names}")
+    hosts_axis, ranks_axis = names
+    h, d = mesh.shape[hosts_axis], mesh.shape[ranks_axis]
+    dcn_wire = "f32"
+    if topology is not None and topology.num_hosts > 1:
+        if (topology.num_hosts != h
+                or (topology.domain_size not in (0, d))
+                or topology.hosts_axis != hosts_axis):
+            raise ValueError(
+                f"topology (num_hosts={topology.num_hosts}, domain_size="
+                f"{topology.domain_size}, hosts_axis="
+                f"{topology.hosts_axis!r}) disagrees with the mesh "
+                f"({hosts_axis!r}={h}, {ranks_axis!r}={d})")
+        dcn_wire = topology.dcn_wire
+    return Topology(num_hosts=h, domain_size=d, hosts_axis=hosts_axis,
+                    ranks_axis=ranks_axis, dcn_wire=dcn_wire)
+
+
+def resolve_mesh_topology(mesh, axis_name: Optional[str] = None,
+                          topology: Optional[TopologyConfig] = None):
+    """The builder-side resolution every ``distributed_*step*`` runs:
+    ``(axis, n, topo)`` where ``axis`` is the flat generation axis (a
+    plain name on 1-D meshes, the ``(hosts, ranks)`` tuple on 2-D), ``n``
+    the total rank count and ``topo`` the `Topology` driving the
+    two-level composite (None = flat single-level)."""
+    topo = topology_of(mesh, topology)
+    if topo is None:
+        axis = axis_name or mesh.axis_names[0]
+        return axis, mesh.shape[axis], None
+    return topo.flat_axis, topo.n_ranks, topo

@@ -73,7 +73,7 @@ def save_session(sess: "InSituSession", path: str) -> None:
         "mode": sess.mode,
         "engine": sess.engine,
         "temporal": bool(getattr(sess, "_temporal", False)),
-        "mesh_devices": int(sess.mesh.shape[sess.cfg.mesh.axis_name]),
+        "mesh_devices": int(sess._n_ranks),
         "frame_index": sess.frame_index,
         "orbit_rate": float(sess.orbit_rate),
         "thr_regimes": sorted(sess._mxu_thr.keys()),
@@ -124,7 +124,7 @@ def load_session(sess: "InSituSession", path: str) -> None:
                           ("temporal", bool(getattr(sess, "_temporal",
                                                     False))),
                           ("mesh_devices",
-                           int(sess.mesh.shape[sess.cfg.mesh.axis_name]))):
+                           int(sess._n_ranks))):
             want = header.get(key)
             if want is not None and want != have:
                 raise ValueError(
@@ -205,7 +205,9 @@ def _thr_shape(sess, regime):
         return None
     axis_sign = tuple(regime[1:]) if regime and regime[0] == "hybrid" \
         else tuple(regime)
-    n = sess.mesh.shape[sess.cfg.mesh.axis_name]
+    # TOTAL rank count — on a hierarchical (hosts, ranks) mesh the
+    # threshold maps stack over the flat axis view (docs/MULTIHOST.md)
+    n = sess._n_ranks
     spec = sess._slicer.make_spec(sess.camera, sess.sim.field.shape,
                                   sess.cfg.slicer, axis_sign=axis_sign,
                                   multiple_of=n)

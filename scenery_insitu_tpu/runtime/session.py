@@ -31,7 +31,8 @@ from scenery_insitu_tpu.config import FrameworkConfig
 from scenery_insitu_tpu.core.camera import Camera, orbit
 from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
 from scenery_insitu_tpu.core.vdi import VDI
-from scenery_insitu_tpu.parallel.mesh import make_mesh
+from scenery_insitu_tpu.parallel.topology import (make_topology_mesh,
+                                                  resolve_mesh_topology)
 from scenery_insitu_tpu.parallel.pipeline import (distributed_plain_step,
                                                   distributed_vdi_step,
                                                   shard_volume)
@@ -245,8 +246,33 @@ class InSituSession:
                  sinks: Sequence[Sink] = (), log=None):
         self.cfg = cfg or FrameworkConfig()
         self.log = log or (lambda s: None)
-        self.mesh = mesh if mesh is not None else make_mesh(
-            self.cfg.mesh.num_devices, self.cfg.mesh.axis_name)
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            # mesh topology is first-class (docs/MULTIHOST.md): a
+            # hierarchical TopologyConfig builds the 2-D (hosts, ranks)
+            # mesh and the distributed steps composite in two levels.
+            # Particle sessions composite sort-first (all_gather +
+            # depth-min) — no sort-last exchange to split — so a
+            # hierarchy request there is inert, ledgered, and the flat
+            # mesh renders
+            topo_cfg = self.cfg.topology
+            particles = (isinstance(sim, ParticleSimAdapter)
+                         or (sim is None and self.cfg.sim.kind
+                             in ("lennard_jones", "sho")))
+            if particles and topo_cfg.num_hosts > 1:
+                _obs.degrade(
+                    "topology.hier", f"num_hosts={topo_cfg.num_hosts}",
+                    "flat", "particle sessions composite sort-first — "
+                    "no two-level sort-last composite to run", warn=False)
+                topo_cfg = None
+            self.mesh, _ = make_topology_mesh(topo_cfg, self.cfg.mesh)
+        # the flat axis view + total rank count every mesh consumer uses
+        # (a plain name on 1-D meshes, the (hosts, ranks) tuple on 2-D)
+        self._flat_axis, self._n_ranks, self._topo = resolve_mesh_topology(
+            self.mesh, topology=(self.cfg.topology
+                                 if len(self.mesh.axis_names) > 1
+                                 else None))
         # the recorder wraps+subsumes the per-phase Timers: every span
         # feeds `self.timers` (same PhaseStats/windowed dumps as before),
         # and with obs enabled also records structured frame/rank events
@@ -358,7 +384,7 @@ class InSituSession:
             self._step = distributed_vdi_step(
                 self.mesh, self.tf, r.width, r.height,
                 self.cfg.vdi, self.cfg.composite, max_steps=r.max_steps,
-                plan=self._plan)
+                plan=self._plan, topology=self.cfg.topology)
         elif self.engine == "mxu":
             # TPU plain mode: slice march + column exchange + nearest-first
             # composite on the intermediate grid, homography-warped to the
@@ -381,7 +407,7 @@ class InSituSession:
                 rebalance_min_depth=cc.rebalance_min_depth,
                 rebalance_quantum=cc.rebalance_quantum,
                 temporal_reuse=cc.temporal_reuse,
-                plan=self._plan)
+                plan=self._plan, topology=self.cfg.topology)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
@@ -558,7 +584,7 @@ class InSituSession:
         if color is None:
             color = np.asarray(out.color)
             depth = np.asarray(out.depth)
-        n = self.mesh.shape[self.cfg.mesh.axis_name]
+        n = self._n_ranks
         tiles = n * self.cfg.composite.wave_tiles
         w_total = color.shape[-1]
         if w_total % tiles:
@@ -590,8 +616,8 @@ class InSituSession:
         from scenery_insitu_tpu.utils.compat import shard_map
 
         if self._profile_fn is None:
-            axis = self.mesh.axis_names[0]
-            n = self.mesh.shape[axis]
+            axis = self._flat_axis
+            n = self._n_ranks
             tf = self.tf
             dn = int(self.sim.field.shape[0]) // n
             nzb = _occ._cap_divisor(dn, 32)
@@ -619,7 +645,7 @@ class InSituSession:
         cc = self.cfg.composite
         if cc.rebalance != "occupancy":
             return
-        n = self.mesh.shape[self.mesh.axis_names[0]]
+        n = self._n_ranks
         if self.mode == "particles" or not hasattr(self.sim, "field") \
                 or n == 1:
             # configured-but-inert knob: say so once instead of silently
@@ -742,24 +768,26 @@ class InSituSession:
             if regime is None:
                 step, seed = self._step, None
             else:
-                n = self.mesh.shape[self.cfg.mesh.axis_name]
+                n = self._n_ranks
                 spec = self._slicer.make_spec(
                     self.camera, self.sim.field.shape, self.cfg.slicer,
                     axis_sign=regime, multiple_of=n)
                 if self._temporal:
                     step = distributed_vdi_step_mxu_temporal(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        comp_cfg, plan=self._plan)
+                        comp_cfg, plan=self._plan,
+                        topology=self.cfg.topology)
                     seed = distributed_initial_threshold_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
                         plan=self._plan)
                 else:
                     step = distributed_vdi_step_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        comp_cfg, plan=self._plan)
+                        comp_cfg, plan=self._plan,
+                        topology=self.cfg.topology)
                     seed = None
             steps_per_frame = self.cfg.sim.steps_per_frame
-            mesh_n = self.mesh.shape[self.cfg.mesh.axis_name]
+            mesh_n = self._n_ranks
             if mesh_n > 1 and self.sim.kind == "gray_scott":
                 # inside the scanned executable GSPMD propagates the
                 # render step's z-sharding back into the sim advance, and
@@ -987,14 +1015,15 @@ class InSituSession:
             self.obs.count("compile_step")
             self.obs.event("compile", frame=self.frame_index,
                            what="hybrid_step", regime=str(regime))
-            n = self.mesh.shape[self.cfg.mesh.axis_name]
+            n = self._n_ranks
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
             step = distributed_hybrid_step_mxu(
                 self.mesh, self.tf, spec, self.cfg.vdi, self.cfg.composite,
                 radius=self.cfg.sim.particle_radius * float(self._spacing[0]),
-                stamp=5, temporal=self._temporal, plan=self._plan)
+                stamp=5, temporal=self._temporal, plan=self._plan,
+                topology=self.cfg.topology)
             seed = (distributed_initial_threshold_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
                         plan=self._plan)
@@ -1044,7 +1073,7 @@ class InSituSession:
             self.obs.count("compile_step")
             self.obs.event("compile", frame=self.frame_index,
                            what="plain_step", regime=str(regime))
-            n = self.mesh.shape[self.cfg.mesh.axis_name]
+            n = self._n_ranks
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
@@ -1061,7 +1090,7 @@ class InSituSession:
                 rebalance_min_depth=cc.rebalance_min_depth,
                 rebalance_quantum=cc.rebalance_quantum,
                 temporal_reuse=cc.temporal_reuse,
-                plan=self._plan)
+                plan=self._plan, topology=self.cfg.topology)
             r = self.cfg.render
             slicer = self._slicer
 
@@ -1095,7 +1124,7 @@ class InSituSession:
             self.obs.count("compile_step")
             self.obs.event("compile", frame=self.frame_index,
                            what="vdi_step", regime=str(regime))
-            n = self.mesh.shape[self.cfg.mesh.axis_name]
+            n = self._n_ranks
             spec = self._slicer.make_spec(self.camera, self.sim.field.shape,
                                           self.cfg.slicer, axis_sign=regime,
                                           multiple_of=n)
@@ -1107,7 +1136,8 @@ class InSituSession:
             if self._temporal:
                 inner = distributed_vdi_step_mxu_temporal(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    self.cfg.composite, plan=self._plan, reuse_tol=tol)
+                    self.cfg.composite, plan=self._plan, reuse_tol=tol,
+                    topology=self.cfg.topology)
                 seed = distributed_initial_threshold_mxu(
                     self.mesh, self.tf, spec, self.cfg.vdi,
                     plan=self._plan)
@@ -1134,7 +1164,8 @@ class InSituSession:
             elif self._reuse:
                 inner = distributed_vdi_step_mxu(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    self.cfg.composite, plan=self._plan, reuse_tol=tol)
+                    self.cfg.composite, plan=self._plan, reuse_tol=tol,
+                    topology=self.cfg.topology)
 
                 def step(field, origin, spacing, cam,
                          _regime=regime, _inner=inner, _rseed=rseed):
@@ -1149,7 +1180,8 @@ class InSituSession:
             else:
                 step = distributed_vdi_step_mxu(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    self.cfg.composite, plan=self._plan)
+                    self.cfg.composite, plan=self._plan,
+                    topology=self.cfg.topology)
             self._mxu_steps[regime] = step
         return step
 

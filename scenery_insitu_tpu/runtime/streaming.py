@@ -426,6 +426,11 @@ class VDISubscriber(_ReconnectSupervisor):
         self.stats = {"frames": 0, "drops": 0, "gaps": 0, "stale": 0,
                       "heartbeats": 0, "epoch_changes": 0, "reconnects": 0,
                       "resyncs": 0}
+        # wire bytes of the most recent multipart message (heartbeats
+        # included) — the receive-side twin of VDIPublisher.last_bytes,
+        # consumed by the hierarchical head assembler's dcn_bytes
+        # accounting (parallel/hier.py)
+        self.last_recv_bytes = 0
         # temporal-delta reconstruction state (docs/PERF.md "Temporal
         # deltas"): transparent — only messages carrying a delta header
         # consult it, and an epoch change resets it (the restarted
@@ -511,6 +516,7 @@ class VDISubscriber(_ReconnectSupervisor):
             elif not self.sock.poll(1000):
                 continue          # blocking mode: re-check liveness 1/s
             parts = self.sock.recv_multipart()
+            self.last_recv_bytes = sum(len(p) for p in parts)
             got = self._decode(parts)
             if got is _HEARTBEAT:
                 if deadline is not None and time.monotonic() >= deadline:

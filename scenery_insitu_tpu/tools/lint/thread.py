@@ -49,6 +49,14 @@ CODE = "SITPU-THREAD"
 BUILDER_RE = re.compile(r"^(distributed_.*step.*|_build_mxu_step)$")
 COMPOSITE_CLASS = "CompositeConfig"
 COMP_PARAM = "comp_cfg"
+# the scale-out plane (docs/MULTIHOST.md): every distributed step builder
+# must accept AND forward the TopologyConfig — a builder that drops it
+# silently renders the flat single-domain composite on a hierarchical
+# mesh, exactly the class of rot this checker exists for. Enforced for
+# whole-object and explicit-knob builders alike (topology is its own
+# config object, not a CompositeConfig field), and the session must bind
+# it at every builder call.
+TOPO_PARAM = "topology"
 
 # consumed inside the composite fold itself (ops/composite.py), not
 # threaded through builder signatures; everything else in CompositeConfig
@@ -97,6 +105,17 @@ def _check_builder(src: SourceFile, fn: ast.FunctionDef,
                    knobs: List[str]) -> List[Diagnostic]:
     diags = []
     params = func_params(fn)
+    if TOPO_PARAM not in params:
+        diags.append(Diagnostic(
+            src.path, fn.lineno, CODE,
+            f"does not accept '{TOPO_PARAM}' (TopologyConfig; every "
+            f"distributed builder must thread the mesh topology — "
+            f"docs/MULTIHOST.md)", fn.name))
+    elif not _name_used_as_call_arg(fn, TOPO_PARAM):
+        diags.append(Diagnostic(
+            src.path, fn.lineno, CODE,
+            f"accepts '{TOPO_PARAM}' but never consumes it — the "
+            f"hierarchical composite is silently dropped", fn.name))
     if COMP_PARAM in params:
         if not _name_used_as_call_arg(fn, COMP_PARAM):
             diags.append(Diagnostic(
@@ -159,6 +178,16 @@ def _check_session_calls(session_src: SourceFile,
         params = func_params(fn)
         kw_names = {k.arg for k in c.keywords if k.arg}
         has_doublestar = any(k.arg is None for k in c.keywords)
+        if TOPO_PARAM in params:
+            idx = _param_index(fn, TOPO_PARAM)
+            bound = (TOPO_PARAM in kw_names or has_doublestar
+                     or (idx is not None and len(c.args) > idx))
+            if not bound:
+                diags.append(Diagnostic(
+                    session_src.path, c.lineno, CODE,
+                    f"call to {name} does not bind '{TOPO_PARAM}' — the "
+                    f"session must thread cfg.topology (a hierarchical "
+                    f"mesh would silently composite flat)", "session"))
         if COMP_PARAM in params:
             idx = _param_index(fn, COMP_PARAM)
             bound = (COMP_PARAM in kw_names or has_doublestar

@@ -39,3 +39,11 @@ try:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "multiproc: spawns real jax.distributed subprocesses "
+        "(the multiproc CI lane selects these with -m multiproc)")

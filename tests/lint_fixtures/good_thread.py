@@ -2,23 +2,31 @@
 # the linter only.
 
 
-def distributed_obj_step(mesh, tf, vdi_cfg=None, comp_cfg=None):
+def distributed_obj_step(mesh, tf, vdi_cfg=None, comp_cfg=None,
+                         topology=None):
     """Whole-object threading: comp_cfg flows into the composite call —
-    every current and future knob rides along."""
+    every current and future knob rides along — and the mesh topology is
+    resolved, not dropped."""
+    topo = resolve_topology(mesh, topology)
+
     def step(data, cam):
-        return composite_cfg(march(data, cam), comp_cfg)
+        return composite_cfg(march(data, cam), comp_cfg, topo)
     return step
 
 
 def distributed_knob_step(mesh, tf, width, height,
                           exchange="all_to_all", wire="f32",
                           schedule="frame", wave_tiles=4,
-                          ring_slots=0, k_budget="static"):
+                          ring_slots=0, k_budget="static",
+                          topology=None):
     """Explicit-knob threading: the full matrix accepted and forwarded."""
+    topo = resolve_topology(mesh, topology)
+
     def step(data, cam):
         return composite(march(data, cam), exchange=exchange, wire=wire,
                          schedule=schedule, wave_tiles=wave_tiles,
-                         ring_slots=ring_slots, k_budget=k_budget)
+                         ring_slots=ring_slots, k_budget=k_budget,
+                         topo=topo)
     return step
 
 
@@ -32,3 +40,7 @@ def composite(frag, **kw):
 
 def composite_cfg(frag, cfg):
     return frag
+
+
+def resolve_topology(mesh, topology):
+    return topology

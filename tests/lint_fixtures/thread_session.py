@@ -11,14 +11,17 @@ def build_good(sess):
         schedule=sess.cfg.composite.schedule,
         wave_tiles=sess.cfg.composite.wave_tiles,
         ring_slots=sess.cfg.composite.ring_slots,
-        k_budget=sess.cfg.composite.k_budget)
+        k_budget=sess.cfg.composite.k_budget,
+        topology=sess.cfg.topology)
     obj = distributed_obj_step(sess.mesh, sess.tf, sess.cfg.vdi,
-                               sess.cfg.composite)
+                               sess.cfg.composite,
+                               topology=sess.cfg.topology)
     return step, obj
 
 
 def build_bad(sess):
     # forgets wire= — the builder default silently masks cfg.composite.wire
+    # (and forgets topology= — a hierarchical mesh would composite flat)
     step = distributed_knob_step(
         sess.mesh, sess.tf, 64, 48,
         exchange=sess.cfg.composite.exchange,
@@ -27,5 +30,6 @@ def build_bad(sess):
         ring_slots=sess.cfg.composite.ring_slots,
         k_budget=sess.cfg.composite.k_budget)
     # never binds comp_cfg — the builder default runs, not the session's
-    obj = distributed_obj_step(sess.mesh, sess.tf)
+    obj = distributed_obj_step(sess.mesh, sess.tf,
+                               topology=sess.cfg.topology)
     return step, obj

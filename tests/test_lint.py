@@ -130,9 +130,26 @@ class TestThread:
     def test_session_plumbing(self):
         diags = thread_check("good_thread.py", with_session=True)
         msgs = [d.message for d in diags]
-        assert len(diags) == 2, [d.render() for d in diags]
+        assert len(diags) == 3, [d.render() for d in diags]
         assert any("does not forward knob 'wire'" in m for m in msgs)
         assert any("does not bind comp_cfg" in m for m in msgs)
+        # the same forgetful call also fails the topology binding rule
+        assert any("does not bind 'topology'" in m for m in msgs)
+
+    def test_topology_threading_enforced(self):
+        """ISSUE 14: every distributed builder must accept AND consume
+        the TopologyConfig — a builder that drops it silently composites
+        flat on a hierarchical mesh."""
+        diags = thread_check("bad_thread.py")
+        by_sym = {}
+        for d in diags:
+            by_sym.setdefault(d.symbol, []).append(d.message)
+        for sym in ("distributed_bad_step", "distributed_missing_step",
+                    "distributed_dropped_obj_step"):
+            assert any("does not accept 'topology'" in m
+                       for m in by_sym[sym]), by_sym[sym]
+        # the compliant fixtures resolve it — clean
+        assert thread_check("good_thread.py") == []
 
     def test_real_builders_thread_whole_matrix(self):
         """The real pipeline/session: only the documented, baselined

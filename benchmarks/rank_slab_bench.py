@@ -44,6 +44,14 @@ speedup the rebalance buys. One chip marches the bands serially
 concurrency is serialized), so the per-rank times are the real
 constituents. ``--out`` writes the JSON artifact
 (rebalance_ab_r10_cpu.json is the committed CPU capture).
+
+--rebalance bricks|all additionally measures the NON-CONVEX brick map
+(ISSUE 15; docs/SCENARIOS.md "Brick maps"): the steal planner
+(parallel.bricks.steal_plan) is converged on the scene's per-brick
+live work and each rank's time is the SUM of its per-brick marches —
+contiguity gone, min-depth/max-depth padding gone, so the dense region
+spreads one brick per rank (bricks_ab_r15_cpu.json is the committed
+CPU capture: even 2.90 -> slabs 1.82 -> bricks 1.08 straggler).
 """
 
 import argparse
@@ -166,28 +174,70 @@ def rebalance_ab(args):
         return [march_band(int(starts[r]), int(p[r]), int(pad_to))
                 for r in range(n)]
 
+    def brick_times(bmap):
+        """Per-rank march time under a brick map = the SUM of the
+        rank's per-brick marches (the real brick path marches each
+        slot separately; serialized here like the band A/B — band
+        contents, bounds and shapes are exactly the distributed
+        ones)."""
+        bz = bmap.brick_depth
+        out_ms = []
+        for r in range(n):
+            ms = 0.0
+            for z0, _ in bmap.intervals(r):
+                ms += march_band(z0, bz, bz)
+            out_ms.append(ms)
+        return out_ms
+
+    # brick-stealing map (ISSUE 15; docs/SCENARIOS.md "Brick maps"):
+    # converge the session's move-capped steal loop up front — the bench
+    # measures the steady-state assignment the replans settle on
+    from scenery_insitu_tpu.parallel import bricks as bk
+
+    nb = getattr(args, "bricks", 0) or bk.auto_nbricks(grid, n)
+    bwork = bk.brick_work(prof, grid, nb)
+    bmap = bk.BrickMap.contiguous(grid, n, nb)
+    for _ in range(4 * nb):
+        nxt = bk.steal_plan(bmap, bwork, max_moves=4, hysteresis=0.05)
+        if nxt is bmap:
+            break
+        bmap = nxt
+
+    run_modes = {"both": ("even", "occupancy"),
+                 "all": ("even", "occupancy", "bricks"),
+                 "bricks": ("even", "bricks"),
+                 "even": ("even",), "occupancy": ("occupancy",)}[
+                     args.rebalance]
     out = {"metric": f"rebalance_ab_{grid}c_{n}ranks_{dev.platform}",
            "unit": "straggler factor reduction (max/mean per-rank march"
-                   " ms, even / occupancy)",
+                   " ms, even / rebalanced)",
            "scene": {"grid": grid,
                      "band_live_spread": round(spread, 2),
                      "z_profile_bins": len(prof)},
            "plan": list(plan),
+           "bricks_map": {"nbricks": nb, "brick_depth": grid // nb,
+                          "owner": list(bmap.owner),
+                          "slots": bmap.slots},
            "modeled": {
                "straggler_even": round(
                    occ.straggler_factor(prof, grid, even), 3),
                "straggler_planned": round(
-                   occ.straggler_factor(prof, grid, plan), 3)},
+                   occ.straggler_factor(prof, grid, plan), 3),
+               "straggler_bricks": round(
+                   bk.straggler_factor(bmap, bwork), 3)},
            "config": {"ranks": n, "k": args.k, "fold": spec.fold,
                       "image": [spec.ni, spec.nj],
                       "min_depth": args.min_depth,
                       "quantum": args.quantum, "iters": args.iters,
                       "platform": dev.platform,
                       "device": dev.device_kind}}
-    for mode, p in (("even", even), ("occupancy", plan)):
-        if args.rebalance not in ("both", mode):
+    for mode in ("even", "occupancy", "bricks"):
+        if mode not in run_modes:
             continue
-        ms = mode_times(p)
+        if mode == "bricks":
+            ms = brick_times(bmap)
+        else:
+            ms = mode_times(even if mode == "even" else plan)
         out[mode] = {
             "per_rank_march_ms": [round(m, 2) for m in ms],
             "max_ms": round(max(ms), 2),
@@ -199,6 +249,12 @@ def rebalance_ab(args):
                              / out["occupancy"]["straggler_factor"], 3)
         out["frame_march_speedup"] = round(
             out["even"]["max_ms"] / out["occupancy"]["max_ms"], 3)
+    if "even" in out and "bricks" in out:
+        out["value_bricks"] = round(out["even"]["straggler_factor"]
+                                    / out["bricks"]["straggler_factor"],
+                                    3)
+        out["frame_march_speedup_bricks"] = round(
+            out["even"]["max_ms"] / out["bricks"]["max_ms"], 3)
     print(json.dumps(out), flush=True)
     if args.out:
         with open(args.out, "w") as f:
@@ -308,10 +364,16 @@ def main():
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rebalance", choices=("both", "even", "occupancy"),
+    ap.add_argument("--rebalance",
+                    choices=("both", "all", "even", "occupancy",
+                             "bricks"),
                     default=None,
                     help="run the render-rebalancing A/B instead of the "
-                         "legacy Config-2 projection")
+                         "legacy Config-2 projection ('bricks' = even "
+                         "vs the brick-stealing map, 'all' = all three)")
+    ap.add_argument("--bricks", type=int, default=0,
+                    help="brick count of the --rebalance bricks mode "
+                         "(0 = auto_nbricks)")
     ap.add_argument("--grid", type=int,
                     default=int(os.environ.get("SITPU_BENCH_GRID",
                                                "64")))

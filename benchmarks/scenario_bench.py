@@ -1,0 +1,178 @@
+"""Per-scenario frame cost + brick-parity gates of the scenario zoo
+(scenery_insitu_tpu/scenarios; docs/SCENARIOS.md; ISSUE 15).
+
+For every registered scenario (or ``--scenarios a,b``): build the
+session from the scenario's bench recipe, run one warmup frame (the
+compile), then time ``bench_frames`` STEERED frames (the scenario's own
+steering hook fires through the protocol consumer — TF schedules
+included, so the recompile-or-reuse counters land in the artifact).
+
+Volume scenarios additionally run the composite PARITY block: one
+frame of the scenario's final field rendered through the gather
+distributed step under (a) the even decomposition, (b) a non-convex
+single-brick-per-rank BrickMap, and (c) an ownership permutation of
+(b) — asserting brick-vs-even <= 1e-5 and permutation-vs-permutation
+BITWISE (the ISSUE-15 invariance contract, on real scenario content).
+
+One JSON line per run; ``--out`` writes the committed artifact
+(results/scenario_bench_r15_cpu.json is the CPU capture).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parity_block(field, tf, n=8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
+    from scenery_insitu_tpu.core.camera import Camera
+    from scenery_insitu_tpu.parallel.bricks import BrickMap
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.parallel.pipeline import (distributed_vdi_step,
+                                                      shard_volume)
+
+    d, h, w = field.shape
+    if jax.device_count() < n:
+        return {"skipped": f"needs {n} devices, have "
+                           f"{jax.device_count()}"}
+    if d % n or (d // n) < 1:
+        return {"skipped": f"depth {d} does not split over {n} ranks"}
+    vox = 2.0 / max(d, h, w)
+    origin = jnp.asarray([-w * vox / 2, -h * vox / 2, -d * vox / 2],
+                         jnp.float32)
+    spacing = jnp.full((3,), vox, jnp.float32)
+    cam = Camera.create((0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.3,
+                        far=20.0)
+    mesh = make_mesh(n)
+    sdata = shard_volume(jnp.asarray(field), mesh)
+    vc = VDIConfig(max_supersegments=6, adaptive_iters=2)
+    owner = (3, 0, 5, 1, 4, 7, 2, 6)
+    bm = BrickMap(d, n, owner)
+    outs = {}
+    for key, bricks in (("even", None), ("bricks", bm),
+                        ("bricks_perm", bm.permute((2, 0, 3, 1, 5, 7,
+                                                    4, 6)))):
+        cc = CompositeConfig(max_output_supersegments=8, adaptive_iters=2,
+                             rebalance="bricks" if bricks else "even")
+        step = distributed_vdi_step(mesh, tf, 32, 32, vc, cc,
+                                    max_steps=48, bricks=bricks)
+        v = step(sdata, origin, spacing, cam)
+        outs[key] = (np.asarray(v.color), np.asarray(v.depth))
+    perm_bitwise = bool(
+        (outs["bricks"][0] == outs["bricks_perm"][0]).all()
+        and (outs["bricks"][1] == outs["bricks_perm"][1]).all())
+    dc = float(np.max(np.abs(outs["bricks"][0] - outs["even"][0])))
+    # finiteness patterns must MATCH before masking — a dropped brick
+    # fragment (finite even depth, +inf bricks depth) is a coverage
+    # regression, not a pixel to exclude
+    inf_match = bool((np.isinf(outs["even"][1])
+                      == np.isinf(outs["bricks"][1])).all())
+    fin = np.isfinite(outs["even"][1]) & np.isfinite(outs["bricks"][1])
+    dd = float(np.max(np.abs(outs["bricks"][1] - outs["even"][1]),
+                      initial=0.0, where=fin))
+    return {"owner": list(owner),
+            "perm_bitwise": perm_bitwise,
+            "inf_pattern_match_vs_even": inf_match,
+            "max_color_diff_vs_even": dc,
+            "max_depth_diff_vs_even": dd,
+            "ok": bool(perm_bitwise and inf_match and dc <= 1e-5
+                       and dd <= 1e-5)}
+
+
+def bench_scenario(name: str, frames: int) -> dict:
+    import jax
+
+    from scenery_insitu_tpu import scenarios
+
+    scn = scenarios.get(name)
+    n_frames = frames or scn.bench_frames
+    sess = scenarios.make_session(
+        name, extra_overrides=scn.bench_overrides
+        + ("obs.enabled=true", "render.max_steps=64"))
+    # warmup = the compile frame (steering hooks held back)
+    jax.block_until_ready(sess.render_frame())
+    t0 = time.perf_counter()
+    scenarios.run_steered(sess, scn, n_frames)
+    dt = time.perf_counter() - t0
+    row = {
+        "frames": n_frames,
+        "ms_per_frame": round(dt * 1e3 / n_frames, 2),
+        "mode": sess.mode,
+        "engine": sess.engine,
+        "steered": scn.steering is not None,
+        "tf_updates": int(sess.obs.counters.get("tf_updates", 0)),
+        "tf_steps_reused": int(sess.obs.counters.get("tf_steps_reused",
+                                                     0)),
+    }
+    if scn.brick_parity and hasattr(sess.sim, "field"):
+        import numpy as np
+
+        row["parity"] = _parity_block(np.asarray(sess.sim.field), sess.tf)
+    return row
+
+
+def main() -> int:
+    if os.environ.get("SITPU_CPU") == "1" \
+            or os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the parity block runs the 8-rank distributed step on the
+        # virtual CPU mesh (the tests/conftest.py stand-in)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    if os.environ.get("SITPU_CPU") == "1":
+        from scenery_insitu_tpu.utils.backend import pin_cpu_backend
+        pin_cpu_backend()
+    from scenery_insitu_tpu.utils.backend import enable_compile_cache
+    enable_compile_cache()
+    import jax
+
+    from scenery_insitu_tpu import scenarios
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenarios", default="",
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--frames", type=int, default=0,
+                    help="override per-scenario bench frame count")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    picks = ([s for s in args.scenarios.split(",") if s]
+             or list(scenarios.names()))
+    dev = jax.devices()[0]
+    rows = {}
+    for name in picks:
+        rows[name] = bench_scenario(name, args.frames)
+        print(json.dumps({name: rows[name]}), flush=True)
+
+    parity_ok = all(r.get("parity", {}).get("ok", True)
+                    for r in rows.values())
+    out = {
+        "metric": f"scenario_bench_{dev.platform}",
+        "unit": "ms/frame per registered scenario (steered; includes "
+                "TF-update recompiles)",
+        "value": len(rows),
+        "scenarios": rows,
+        "parity_ok": parity_ok,
+        "config": {"platform": dev.platform,
+                   "device": dev.device_kind,
+                   "registered": list(scenarios.names())},
+    }
+    print(json.dumps(out), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0 if parity_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

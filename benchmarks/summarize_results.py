@@ -83,15 +83,45 @@ def fmt(r: dict) -> str:
                     f"(x{w.get('bytes_ratio')}), records {w.get('records')}"
                     f", bitexact={w.get('recon_bitexact_vs_qpack8')}")
         return "\n   ".join(lines)
-    if "plan" in r and "even" in r and "occupancy" in r:   # rebalance A/B
-        ev, oc = r["even"], r["occupancy"]
-        return (f"{r.get('metric', 'rebalance_ab')}: straggler "
-                f"{ev.get('straggler_factor')} -> "
-                f"{oc.get('straggler_factor')} "
-                f"(x{r.get('value')} reduction, frame march "
-                f"x{r.get('frame_march_speedup')})\n   "
-                f"  plan={r['plan']} max_ms {ev.get('max_ms')} -> "
-                f"{oc.get('max_ms')}")
+    if "plan" in r and "even" in r \
+            and ("occupancy" in r or "bricks" in r):   # rebalance A/B
+        ev = r["even"]
+        lines = [f"{r.get('metric', 'rebalance_ab')}: even straggler "
+                 f"{ev.get('straggler_factor')} "
+                 f"(max_ms {ev.get('max_ms')})"]
+        if "occupancy" in r:
+            oc = r["occupancy"]
+            lines.append(f"  slabs  -> {oc.get('straggler_factor')} "
+                         f"(x{r.get('value')} reduction, frame march "
+                         f"x{r.get('frame_march_speedup')}) "
+                         f"plan={r['plan']}")
+        if "bricks" in r:
+            bb = r["bricks"]
+            bm = r.get("bricks_map", {})
+            lines.append(f"  bricks -> {bb.get('straggler_factor')} "
+                         f"(x{r.get('value_bricks')} reduction, frame "
+                         f"march x{r.get('frame_march_speedup_bricks')})"
+                         f" nbricks={bm.get('nbricks')} "
+                         f"slots={bm.get('slots')}")
+        return "\n   ".join(lines)
+    if "scenarios" in r and str(r.get("metric", "")).startswith(
+            "scenario_bench"):                     # scenario zoo bench
+        lines = [f"{r['metric']}: {r.get('value')} scenario(s), "
+                 f"parity_ok={r.get('parity_ok')}"]
+        for name, row in sorted(r["scenarios"].items()):
+            par = row.get("parity")
+            extra = ""
+            if par:
+                extra = (f"  parity ok={par.get('ok')} "
+                         f"perm_bitwise={par.get('perm_bitwise')}"
+                         if "ok" in par else f"  parity {par}")
+            if row.get("tf_updates"):
+                extra += (f"  tf {row['tf_updates']} upd/"
+                          f"{row['tf_steps_reused']} reused")
+            lines.append(f"  {name:14s} {row.get('ms_per_frame'):8.1f} "
+                         f"ms/frame [{row.get('mode')}/{row.get('engine')}]"
+                         f"{extra}")
+        return "\n   ".join(lines)
     if "measured" in r and "model" in r:         # occupancy A/B
         modes = (r["measured"] or {}).get("modes", {})
         ms = " ".join(f"{m}={v.get('ms_per_frame')}ms"

@@ -152,6 +152,14 @@ run_step() {
     14) run_json "$R/hier_device_tpu_${ROUND}.json" 1200 env \
          SITPU_BENCH_REAL=1 python benchmarks/scaling_bench.py \
          --mode hier-device --grid 128 --k 8 --frames 10 ;;
+    # brick-stealing A/B: per-rank march straggler, even vs slab plan
+    # vs the non-convex brick map on a skewed 256^3 scene
+    # (docs/SCENARIOS.md "Brick maps"; the committed CPU capture is
+    # bricks_ab_r15_cpu)
+    15) run_json "$R/bricks_ab_tpu_${ROUND}.json" 1500 \
+         python benchmarks/rank_slab_bench.py --rebalance all \
+         --grid 256 --iters 3 \
+         --out "$R/bricks_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
@@ -171,10 +179,11 @@ step_out() {
     12) echo "$R/delta_ab_tpu_${ROUND}.json" ;;
     13) echo "$R/serve_bench_tpu_${ROUND}.json" ;;
     14) echo "$R/hier_device_tpu_${ROUND}.json" ;;
+    15) echo "$R/bricks_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=14
+NSTEPS=15
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

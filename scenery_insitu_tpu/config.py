@@ -264,6 +264,18 @@ class CompositeConfig:
     #                computed host-side between frames from fetched live
     #                fractions; a plan CHANGE recompiles the step — the
     #                quantum + hysteresis below bound how often.
+    #   "bricks"     the render decomposition is a NON-CONVEX brick map
+    #                (parallel/bricks.BrickMap; docs/SCENARIOS.md): the
+    #                global z extent splits into rebalance_bricks equal
+    #                bricks and the session re-plans by brick-STEALING —
+    #                greedy per-brick live-work equalization moving at
+    #                most rebalance_max_moves bricks per replan
+    #                (parallel.bricks.steal_plan). Each rank marches its
+    #                brick set through per-brick ownership intervals;
+    #                the sort-last composite is invariant to which rank
+    #                owns which brick (tests/test_bricks.py), and the
+    #                even-convex map short-circuits bitwise to the
+    #                pre-brick path.
     rebalance: str = "even"
     # Temporal fragment reuse (docs/PERF.md "Temporal deltas"):
     #   "off"     every frame re-marches every rank (the pre-ISSUE-12
@@ -300,6 +312,16 @@ class CompositeConfig:
     # Band boundaries snap to multiples of this many slices — coarser
     # quanta mean fewer distinct plans, fewer recompiles.
     rebalance_quantum: int = 4
+    # rebalance="bricks": brick count of the regular z brick grid. 0 =
+    # auto (parallel.bricks.auto_nbricks: the largest divisor of the
+    # depth at most 4 * n_ranks — fine enough to steal by, coarse
+    # enough that per-brick march overhead stays small).
+    rebalance_bricks: int = 0
+    # rebalance="bricks": bricks allowed to change owner per replan.
+    # Caps both the recompile delta and the extra reslab routing one
+    # replan can introduce (each move is one more distinct shard offset
+    # the ppermute rotation set may need).
+    rebalance_max_moves: int = 2
 
     def __post_init__(self):
         if self.exchange not in ("all_to_all", "ring"):
@@ -323,9 +345,9 @@ class CompositeConfig:
         if self.k_budget_min < 1:
             raise ValueError(f"k_budget_min must be >= 1, "
                              f"got {self.k_budget_min}")
-        if self.rebalance not in ("even", "occupancy"):
-            raise ValueError(f"rebalance must be 'even' or 'occupancy', "
-                             f"got {self.rebalance!r}")
+        if self.rebalance not in ("even", "occupancy", "bricks"):
+            raise ValueError(f"rebalance must be 'even', 'occupancy' or "
+                             f"'bricks', got {self.rebalance!r}")
         if self.temporal_reuse not in ("off", "ranges"):
             raise ValueError(f"temporal_reuse must be 'off' or 'ranges', "
                              f"got {self.temporal_reuse!r}")
@@ -341,6 +363,12 @@ class CompositeConfig:
         if self.rebalance_quantum < 1:
             raise ValueError(f"rebalance_quantum must be >= 1, "
                              f"got {self.rebalance_quantum}")
+        if self.rebalance_bricks < 0:
+            raise ValueError(f"rebalance_bricks must be >= 0 (0 = auto), "
+                             f"got {self.rebalance_bricks}")
+        if self.rebalance_max_moves < 1:
+            raise ValueError(f"rebalance_max_moves must be >= 1, "
+                             f"got {self.rebalance_max_moves}")
 
 
 @dataclass(frozen=True)

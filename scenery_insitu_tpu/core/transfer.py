@@ -209,6 +209,12 @@ DATASET_TRANSFER_FUNCTIONS = {
     # must agree on one TF)
     "vortex": lambda: TransferFunction.ramp(0.0, 1.0, 0.4, "jet"),
     "hybrid": lambda: TransferFunction.ramp(0.0, 1.0, 0.4, "jet"),
+    # particle sims render sort-first splats and never consult the TF,
+    # but the session still constructs one — registering them keeps a
+    # REGISTERED scenario (scenery_insitu_tpu/scenarios) off the
+    # unknown-dataset ledger
+    "lennard_jones": lambda: TransferFunction.ramp(0.05, 0.8, 0.5, "hot"),
+    "sho": lambda: TransferFunction.ramp(0.05, 0.8, 0.5, "hot"),
 }
 
 

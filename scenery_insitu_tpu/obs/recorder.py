@@ -92,6 +92,9 @@ def degrade(component: str, from_: str, to: str, reason: str,
 _LEDGER_REGISTRY: Dict[str, str] = {
     "bench.adaptive_mode": "bench: temporal adaptive mode needs the mxu "
                            "engine; histogram runs instead",
+    "bricks.partition": "a brick render partition was configured where "
+                        "the builder has no brick march (hybrid/plain "
+                        "steps); the even z-slab decomposition renders",
     "bench.autotune_fold": "bench: a fold-autotune candidate crashed and "
                            "is dropped from the race",
     "bench.codec": "benchmarks: a codec under test is unavailable and "
@@ -163,6 +166,10 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                     "seg stack runs",
     "phase_bench.sim_fused": "phase_bench: --sim-fused needs a 1-rank "
                              "mesh; xla_roll runs",
+    "scenario.tf_update": "a steered transfer function not seen before "
+                          "rebuilt the compiled steps (a repeated TF "
+                          "restores its cached steps instead — the "
+                          "recompile-or-reuse contract)",
     "session.scan_block": "a scan block fell back to eager frames "
                           "(regime change or steering drain)",
     "session.scan_frames": "scan_frames configured but unsupported in "

@@ -103,6 +103,50 @@ def validate_plan(plan, n: int, h: int = 1,
     return plan
 
 
+def _reslab_rows(local: jnp.ndarray, g_all, live_all,
+                 axis_name: str = DEFAULT_AXIS) -> jnp.ndarray:
+    """Materialize per-rank row sets from even z-shards — the shared
+    core of `reslab_z` (contiguous bands) and `reslab_bricks`
+    (arbitrary brick sets).
+
+    ``g_all`` i32[n, R]: each rank's clamped GLOBAL source row per
+    output row; ``live_all`` bool[n, R]: rows to fill (dead rows stay
+    zero). Both are static numpy — the ladder is build-time geometry.
+    Mechanism: one ``ppermute`` rotation per distinct (source − dest)
+    shard offset any live row needs; each received even shard
+    contributes its rows via a masked row gather. Near-even plans need
+    2-3 hops; an adversarial brick map can need up to n-1 (correctness
+    first — the steal planner's move cap keeps production maps local)."""
+    import numpy as np
+
+    from scenery_insitu_tpu.utils.compat import axis_size
+    n = axis_size(axis_name)
+    dn = local.shape[0]
+    g_all = np.asarray(g_all, np.int64)
+    live_all = np.asarray(live_all, bool)
+    offsets = sorted({int(o) for r in range(n)
+                      for o in np.unique(g_all[r][live_all[r]] // dn) - r
+                      } or {0})
+
+    ri = jax.lax.axis_index(axis_name)
+    g = jnp.asarray(g_all, jnp.int32)[ri]                 # [R]
+    live = jnp.asarray(live_all)[ri]                      # [R]
+    src = g // dn                                         # absolute source
+    loc = g - src * dn                                    # row within shard
+    bshape = (g_all.shape[1],) + (1,) * (local.ndim - 1)
+    out = jnp.zeros((g_all.shape[1],) + local.shape[1:], local.dtype)
+    for o in offsets:
+        if o == 0:
+            recv = local
+        else:
+            perm = [(i, (i - o) % n) for i in range(n)]
+            recv = jax.lax.ppermute(local, axis_name, perm)
+        sel = (src == ri + o) & live
+        out = jnp.where(sel.reshape(bshape), jnp.take(recv, loc, axis=0),
+                        out)
+    return out
+
+
 def reslab_z(local: jnp.ndarray, plan, axis_name: str = DEFAULT_AXIS,
              h: int = 1) -> jnp.ndarray:
     """Materialize this rank's PLANNED render band from the even z-slab
@@ -141,24 +185,43 @@ def reslab_z(local: jnp.ndarray, plan, axis_name: str = DEFAULT_AXIS,
     # clamped global row ladder of every dest rank's output buffer
     lo = starts - h                                       # may be negative
     g_all = np.clip(lo[:, None] + np.arange(out_depth)[None, :], 0, d - 1)
-    offsets = sorted({int(o) for r in range(n)
-                      for o in np.unique(g_all[r] // dn) - r})
+    live_all = (np.arange(out_depth)[None, :]
+                < (np.asarray(plan)[:, None] + 2 * h))    # trailing pad dead
+    return _reslab_rows(local, g_all, live_all, axis_name)
 
-    ri = jax.lax.axis_index(axis_name)
-    g = jnp.asarray(g_all, jnp.int32)[ri]                 # [out_depth]
-    src = g // dn                                         # absolute source
-    loc = g - src * dn                                    # row within shard
-    band = jnp.asarray(plan, jnp.int32)[ri] + 2 * h       # live rows
-    live = jnp.arange(out_depth) < band
-    bshape = (out_depth,) + (1,) * (local.ndim - 1)
-    out = jnp.zeros((out_depth,) + local.shape[1:], local.dtype)
-    for o in offsets:
-        if o == 0:
-            recv = local
-        else:
-            perm = [(i, (i - o) % n) for i in range(n)]
-            recv = jax.lax.ppermute(local, axis_name, perm)
-        sel = (src == ri + o) & live
-        out = jnp.where(sel.reshape(bshape), jnp.take(recv, loc, axis=0),
-                        out)
-    return out
+
+def reslab_bricks(local: jnp.ndarray, bmap, axis_name: str = DEFAULT_AXIS,
+                  h: int = 1) -> jnp.ndarray:
+    """Materialize this rank's BRICK SET from the even z-slab shards
+    (docs/SCENARIOS.md "Brick maps"): ``bmap`` is a
+    `parallel.bricks.BrickMap`; each of the rank's ``bmap.slots`` slots
+    holds one brick's global rows ``[start - h, start + bz + h)`` with
+    exactly `halo_exchange_z`'s boundary contract (rows clamp only at
+    the GLOBAL edges; interior brick faces receive their true
+    neighbors, whichever rank owns them — what keeps per-brick
+    interpolation seam-exact under any ownership). Absent slots (a rank
+    owning fewer bricks than the busiest) come back all-zero.
+
+    Returns ``[slots, bz + 2h, H, W]`` — `_reslab_rows` does the
+    ppermute routing on the flattened ladder."""
+    import numpy as np
+
+    from scenery_insitu_tpu.utils.compat import axis_size
+    n = axis_size(axis_name)
+    if bmap.n_ranks != n:
+        raise ValueError(f"brick map built for {bmap.n_ranks} ranks on a "
+                         f"{n}-rank mesh")
+    dn = local.shape[0]
+    d = dn * n
+    if bmap.depth != d:
+        raise ValueError(f"brick map covers depth {bmap.depth} but the "
+                         f"volume has {d} slices")
+    bz = bmap.brick_depth
+    rows = bz + 2 * h
+    table = bmap.start_table()                            # [n, B]
+    ladder = np.arange(rows)[None, None, :] - h
+    g_all = np.clip(table[:, :, None] + ladder, 0, d - 1)
+    live_all = np.broadcast_to((table >= 0)[:, :, None], g_all.shape)
+    out = _reslab_rows(local, g_all.reshape(n, -1),
+                       live_all.reshape(n, -1), axis_name)
+    return out.reshape((bmap.slots, rows) + local.shape[1:])

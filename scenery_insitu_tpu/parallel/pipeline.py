@@ -96,7 +96,8 @@ from scenery_insitu_tpu.core.volume import Volume
 from scenery_insitu_tpu.ops.composite import composite_plain, composite_vdis
 from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
-from scenery_insitu_tpu.parallel.mesh import halo_exchange_z, reslab_z
+from scenery_insitu_tpu.parallel.mesh import (halo_exchange_z,
+                                              reslab_bricks, reslab_z)
 from scenery_insitu_tpu.parallel.topology import resolve_mesh_topology
 
 from scenery_insitu_tpu.utils.compat import shard_map
@@ -615,6 +616,283 @@ def _resolve_plan(comp_cfg, n: int, plan, min_halo: int = 1):
     return plan
 
 
+def _bricks_build_marker(bmap, n: int) -> None:
+    """Host-side trace-time marker of one brick-partitioned step build
+    (docs/OBSERVABILITY.md): the brick grid, the padded slot count every
+    rank marches, and the ownership histogram."""
+    from scenery_insitu_tpu import obs as _obs
+
+    counts = [len(bmap.rank_bricks(r)) for r in range(n)]
+    rec = _obs.get_recorder()
+    rec.count("bricks_steps_built")
+    rec.event("bricks_build", ranks=n, nbricks=bmap.nbricks,
+              brick_depth=bmap.brick_depth, slots=bmap.slots,
+              owner=list(bmap.owner), bricks_per_rank=counts)
+
+
+def _resolve_bricks(comp_cfg, n: int, bricks):
+    """Build-time resolution of a brick→rank render partition for a
+    step builder (CompositeConfig.rebalance == "bricks";
+    docs/SCENARIOS.md "Brick maps"). Returns the validated
+    `parallel.bricks.BrickMap`, or None for the slab fast path: no map,
+    a single-rank mesh (every map is the whole volume there), or the
+    even-convex map — which short-circuits BITWISE to the pre-brick
+    path (the composite-invariance anchor). A map without
+    ``rebalance="bricks"`` is a caller bug, not a silent ignore."""
+    if bricks is None:
+        return None
+    from scenery_insitu_tpu.parallel.bricks import BrickMap
+
+    if not isinstance(bricks, BrickMap):
+        raise TypeError(f"bricks= takes a parallel.bricks.BrickMap, got "
+                        f"{type(bricks).__name__}")
+    if comp_cfg is None:
+        rebalance = "even"
+    elif isinstance(comp_cfg, str):
+        rebalance = comp_cfg
+    else:
+        rebalance = comp_cfg.rebalance
+    if rebalance != "bricks":
+        raise ValueError(
+            f"a brick map was passed but rebalance={rebalance!r} — brick "
+            f"partitions are the mechanism of rebalance='bricks'")
+    if bricks.n_ranks != n:
+        raise ValueError(f"brick map built for {bricks.n_ranks} ranks on "
+                         f"a {n}-rank mesh")
+    if n == 1 or bricks.is_even_convex():
+        return None
+    _bricks_build_marker(bricks, n)
+    return bricks
+
+
+def _bricks_inert(bricks, where: str):
+    """Builders with no brick march (hybrid, plain, particle layers)
+    must say a configured brick partition is inert, not silently render
+    the even decomposition."""
+    if bricks is None:
+        return None
+    from scenery_insitu_tpu import obs as _obs
+
+    _obs.degrade("bricks.partition", "bricks", "slabs",
+                 f"{where} has no brick march (gather/MXU VDI steps "
+                 "only); the even z-slab decomposition renders",
+                 warn=False)
+    return None
+
+
+def _brick_units(local_data, origin, spacing, spec, axis, n, bmap):
+    """Per-brick march units of this rank under a BrickMap — the brick
+    generalization of `_rank_slab` (docs/SCENARIOS.md "Brick maps").
+
+    Materializes the rank's brick set ONCE (`mesh.reslab_bricks`, halo
+    rows from the TRUE global neighbors whichever rank owns them) and
+    returns ``([(vol, v_bounds, w_bounds)] * slots, gmax, dims)`` — one
+    unit per brick slot, each a `_rank_slab`-shaped (volume, ownership
+    bounds) pair the existing per-chunk march consumes unchanged:
+    z marches own their brick through the ``w_bounds`` world interval,
+    x/y marches through the ``v_bounds`` half-open interval (the brick
+    owning the global top keeps the even path's +dz edge slack). Absent
+    slots (rank owns fewer bricks than the busiest) carry zero rows and
+    an EMPTY interval — every sample masks dead, the occupancy pyramid
+    admits them as dead, and the fragment comes out all-+inf."""
+    if getattr(spec, "render_dtype", "f32") == "bf16" \
+            and local_data.dtype == jnp.float32:
+        local_data = local_data.astype(jnp.bfloat16)
+    r = jax.lax.axis_index(axis)
+    dn = local_data.shape[0]
+    h, w = local_data.shape[1], local_data.shape[2]
+    d = dn * n
+    dz = spacing[2]
+    gmax = origin + jnp.array([w, h, d], jnp.float32) * spacing
+    bz = bmap.brick_depth
+    table = jnp.asarray(bmap.start_table(), jnp.int32)     # [n, B]
+    z_march = spec.axis == 2
+    bands = reslab_bricks(local_data, bmap, axis,
+                          h=0 if z_march else 1)
+    units = []
+    for s in range(bmap.slots):
+        start = table[r, s]                                # -1 = absent
+        present = start >= 0
+        startf = start.astype(jnp.float32)
+        z_lo = origin[2] + startf * dz
+        z_hi = origin[2] + (startf + bz) * dz
+        if z_march:
+            vol = Volume(bands[s], origin.at[2].add(startf * dz), spacing)
+            # open-interval march ownership (slice centers sit half a
+            # voxel inside); an absent slot's interval is empty
+            wb = (jnp.where(present, z_lo, jnp.inf),
+                  jnp.where(present, z_hi, -jnp.inf))
+            units.append((vol, None, wb))
+        else:
+            vol = Volume(bands[s], origin.at[2].add((startf - 1.0) * dz),
+                         spacing)
+            # the brick covering the global top keeps the even path's
+            # +dz slack (its clamped halo row may re-admit pos == max)
+            hi = jnp.where(start + bz == d, z_hi + dz, z_hi)
+            vb = (jnp.where(present, z_lo, jnp.inf),
+                  jnp.where(present, hi, -jnp.inf))
+            units.append((vol, vb, None))
+    return units, gmax, (w, h, d)
+
+
+def _brick_clip_units(local_data, origin, spacing, d_global, axis, bmap):
+    """`_local_volume_and_clip`'s brick twin for the gather engine: one
+    (volume, clip AABB) per brick slot. The clip AABBs tile the global
+    volume exactly like the slab AABBs do (absent slots get an empty
+    box), and the sample ladder stays the GLOBAL box — which is what
+    makes the composited frame bitwise invariant to ownership."""
+    r = jax.lax.axis_index(axis)
+    h, w = local_data.shape[1], local_data.shape[2]
+    dz = spacing[2]
+    gmax = origin + jnp.array([w, h, d_global], jnp.float32) * spacing
+    bz = bmap.brick_depth
+    table = jnp.asarray(bmap.start_table(), jnp.int32)
+    bands = reslab_bricks(local_data, bmap, axis, h=1)
+    units = []
+    for s in range(bmap.slots):
+        start = table[r, s]
+        present = start >= 0
+        startf = start.astype(jnp.float32)
+        vol = Volume(bands[s], origin.at[2].add((startf - 1.0) * dz),
+                     spacing)
+        z_lo = origin[2] + startf * dz
+        z_hi = origin[2] + (startf + bz) * dz
+        cmin = jnp.stack([origin[0], origin[1],
+                          jnp.where(present, z_lo, jnp.inf)])
+        cmax = jnp.stack([gmax[0], gmax[1],
+                          jnp.where(present, z_hi, -jnp.inf)])
+        units.append((vol, cmin, cmax))
+    return units, gmax
+
+
+def _thr_slot(thr, s: int, nj: int):
+    """Brick slot ``s``'s [nj, ni] threshold maps out of the row-stacked
+    per-rank state (slots stack along rows, ranks along the mesh axis —
+    the `_thr_state_spec` sharding is unchanged)."""
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(lambda m: m[s * nj:(s + 1) * nj], thr)
+
+
+def _stack_thr(states):
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(lambda *xs: jnp.concatenate(xs, axis=0), *states)
+
+
+def _mxu_rank_generate_bricks(local_data, origin, spacing, cam, slicer,
+                              spec, tf, vdi_cfg, axis, n, bmap,
+                              threshold=None):
+    """Per-rank brick-set VDI generation on the MXU engine: march each
+    brick slot through the existing per-chunk machinery (per-brick
+    ownership bounds, per-brick occupancy pyramid) and CONCATENATE the
+    K-slot fragments into one ``[slots*K]`` pre-exchange stream — the
+    downstream exchange + composite sort per pixel anyway
+    (`sort_stream` / the ring's unconditional local sort), so
+    interleaved per-brick depth ranges need no pre-merge. Every brick's
+    fragment depends only on the brick, the camera and the field —
+    never on which rank marched it — which is the composite-invariance
+    argument (tests/test_bricks.py). Temporal mode carries one
+    [nj, ni] threshold map set PER SLOT, row-stacked.
+
+    Returns (vdi [slots*K], meta, axcam, thr')."""
+    units, gmax, dims = _brick_units(local_data, origin, spacing, spec,
+                                     axis, n, bmap)
+    axcam = slicer.make_axis_camera(units[0][0], cam, spec,
+                                    box_min=origin, box_max=gmax)
+    nj = spec.nj
+    colors, depths, thr2s = [], [], []
+    for s, (vol, vb, wb) in enumerate(units):
+        if threshold is None:
+            vdi, _, _ = slicer.generate_vdi_mxu(
+                vol, tf, cam, spec, vdi_cfg, v_bounds=vb, w_bounds=wb,
+                axcam=axcam)
+        else:
+            vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
+                vol, tf, cam, spec, _thr_slot(threshold, s, nj), vdi_cfg,
+                v_bounds=vb, w_bounds=wb, axcam=axcam)
+            thr2s.append(t2)
+        colors.append(vdi.color)
+        depths.append(vdi.depth)
+    thr2 = _stack_thr(thr2s) if thr2s else None
+    meta = slicer._vdi_meta(units[0][0], axcam, spec.ni, spec.nj, 0)
+    meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
+    return (VDI(jnp.concatenate(colors, axis=0),
+                jnp.concatenate(depths, axis=0)), meta, axcam, thr2)
+
+
+def _mxu_rank_generate_bricks_waves(local_data, origin, spacing, cam,
+                                    slicer, spec, tf, vdi_cfg, comp_cfg,
+                                    axis, n, bmap, threshold=None,
+                                    topo=None):
+    """Tile-wave twin of `_mxu_rank_generate_bricks`: per wave, march
+    every brick slot on the wave camera's column block and concatenate
+    the slot fragments into that wave's ``[slots*K]`` pre-exchange
+    stream; wave w's fragments circulate while wave w+1 marches exactly
+    like the slab path. Per-slot permuted copies and occupancy pyramids
+    are built once per frame and shared by every wave."""
+    import jax.tree_util as jtu
+
+    from scenery_insitu_tpu.ops import occupancy as _occ
+
+    units, gmax, dims = _brick_units(local_data, origin, spacing, spec,
+                                     axis, n, bmap)
+    t = comp_cfg.wave_tiles
+    slicer.wave_block(spec.ni, n, t)
+    axcam = slicer.make_axis_camera(units[0][0], cam, spec,
+                                    box_min=origin, box_max=gmax)
+    volps = [slicer.permute_volume(vol, spec) for vol, _, _ in units]
+    pyrs = [(_occ.pyramid_from_volume(vol, tf, spec, volp=vp)
+             if spec.skip_empty else None)
+            for (vol, _, _), vp in zip(units, volps)]
+    _wave_build_marker(n, t, bmap.slots * vdi_cfg.max_supersegments,
+                       spec.nj, spec.ni,
+                       comp_cfg.max_output_supersegments,
+                       comp_cfg.exchange, comp_cfg.ring_slots,
+                       comp_cfg.wire, marched=True)
+    nj = spec.nj
+
+    def march_wave(w, thr_full):
+        axcam_w, spec_w = slicer.wave_camera(axcam, spec, n, t, w)
+        cs, ds, t2s = [], [], []
+        for s, (vol, vb, wb) in enumerate(units):
+            thr_s = (None if thr_full is None else
+                     jtu.tree_map(lambda m: slicer.wave_cols(m, n, t, w),
+                                  _thr_slot(thr_full, s, nj)))
+            if thr_s is None:
+                vdi, _, _ = slicer.generate_vdi_mxu(
+                    vol, tf, cam, spec_w, vdi_cfg, v_bounds=vb,
+                    w_bounds=wb, occupancy=pyrs[s], axcam=axcam_w,
+                    volp=volps[s])
+            else:
+                vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
+                    vol, tf, cam, spec_w, thr_s, vdi_cfg, v_bounds=vb,
+                    w_bounds=wb, occupancy=pyrs[s], axcam=axcam_w,
+                    volp=volps[s])
+                t2s.append(t2)
+            cs.append(vdi.color)
+            ds.append(vdi.depth)
+        if thr_full is not None:
+            parts = [jtu.tree_map(
+                lambda m, mw: slicer.wave_update_cols(m, mw, n, t, w),
+                _thr_slot(thr_full, s, nj), t2s[s])
+                for s in range(len(units))]
+            thr_full = _stack_thr(parts)
+        return (jnp.concatenate(cs, axis=0),
+                jnp.concatenate(ds, axis=0)), thr_full
+
+    def compose(fr):
+        out = _composite_exchanged(fr[0], fr[1], n, axis, comp_cfg,
+                                   topo=topo)
+        return out.color, out.depth
+
+    (oc, od), thr2 = _wave_pipeline(t, march_wave, compose, threshold)
+    vdi = VDI(_wave_assemble(oc), _wave_assemble(od))
+    meta = slicer._vdi_meta(units[0][0], axcam, spec.ni, spec.nj, 0)
+    meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
+    return vdi, meta, axcam, thr2
+
+
 def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
                          n: int, axis_name: str, wire: str = "f32",
                          hop_counter: str = "ring_steps_built",
@@ -736,7 +1014,7 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
                          comp_cfg: Optional[CompositeConfig] = None,
                          max_steps: int = 256,
                          axis_name: Optional[str] = None,
-                         plan=None, topology=None):
+                         plan=None, bricks=None, topology=None):
     """Build the jitted distributed VDI render step.
 
     Returns ``f(vol_data f32[D, H, W] (z-sharded), origin f32[3],
@@ -769,9 +1047,29 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
     _resolve_reuse(comp_cfg, supported=False,
                    where="the gather-engine distributed step")
     plan = _resolve_plan(comp_cfg, n, plan)
+    bricks = _resolve_bricks(comp_cfg, n, bricks)
 
     def step(local_data, origin, spacing, cam: Camera) -> VDI:
         d_global = local_data.shape[0] * n
+        if bricks is not None:
+            # non-convex partition (docs/SCENARIOS.md): one K-fragment
+            # per brick against the brick's clip AABB on the GLOBAL
+            # sample ladder; the concatenated stream is sorted by the
+            # composite, so the frame is bitwise invariant to ownership
+            units, smax = _brick_clip_units(
+                local_data, origin, spacing, d_global, axis, bricks)
+            smin = origin
+            cs, ds = [], []
+            for vol, cmin, cmax in units:
+                vdi, _ = generate_vdi(vol, tf, cam, width, height,
+                                      vdi_cfg, max_steps=max_steps,
+                                      clip_min=cmin, clip_max=cmax,
+                                      sample_min=smin, sample_max=smax)
+                cs.append(vdi.color)
+                ds.append(vdi.depth)
+            return _composite_exchanged_sched(
+                jnp.concatenate(cs, axis=0), jnp.concatenate(ds, axis=0),
+                n, axis, comp_cfg, topo=topo)
         vol, cmin, cmax, smin, smax = _local_volume_and_clip(
             local_data, origin, spacing, d_global, axis, plan=plan)
         vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
@@ -1192,7 +1490,8 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
                              spec, vdi_cfg: Optional[VDIConfig] = None,
                              comp_cfg: Optional[CompositeConfig] = None,
                              axis_name: Optional[str] = None,
-                             plan=None, reuse_tol: float = 0.0,
+                             plan=None, bricks=None,
+                             reuse_tol: float = 0.0,
                              topology=None):
     """Distributed sort-last VDI pipeline on the MXU slice-march engine
     (ops/slicer.py) — generation runs as banded-matmul slice resampling
@@ -1215,13 +1514,13 @@ def distributed_vdi_step_mxu(mesh: Mesh, tf: TransferFunction,
     ``reuse_tol`` is the dirty tolerance (cfg.delta.range_tol).
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                           temporal=False, plan=plan,
+                           temporal=False, plan=plan, bricks=bricks,
                            reuse_tol=reuse_tol, topology=topology)
 
 
 def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                    temporal: bool, plan=None, reuse_tol: float = 0.0,
-                    topology=None):
+                    temporal: bool, plan=None, bricks=None,
+                    reuse_tol: float = 0.0, topology=None):
     """Shared builder of the MXU sort-last step (generate → column
     exchange under ``comp_cfg.exchange`` → composite), with or without
     carried temporal threshold state threaded through.
@@ -1244,9 +1543,32 @@ def _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
                          f"mesh size {n}")
     waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
     plan = _resolve_plan(comp_cfg, n, plan)
-    reuse = _resolve_reuse(comp_cfg)
+    bricks = _resolve_bricks(comp_cfg, n, bricks)
+    if bricks is not None and comp_cfg.k_budget == "occupancy":
+        # per-brick marches derive no per-rank psum budget (a brick's
+        # pyramid sees one brick, not the rank's live share)
+        from scenery_insitu_tpu import obs as _obs
+
+        _obs.degrade("occupancy.k_budget", "occupancy", "static",
+                     "brick-partitioned MXU steps derive no per-rank "
+                     "psum budget (slab decompositions only)", warn=False)
+    reuse = _resolve_reuse(comp_cfg, supported=bricks is None,
+                           where="the brick-partitioned MXU step")
 
     def body(local_data, origin, spacing, cam, thr, ru):
+        if bricks is not None:
+            if waves:
+                out, meta, _, thr2 = _mxu_rank_generate_bricks_waves(
+                    local_data, origin, spacing, cam, slicer, spec, tf,
+                    vdi_cfg, comp_cfg, axis, n, bricks, threshold=thr,
+                    topo=topo)
+                return out, meta, thr2, None
+            vdi, meta, _, thr2 = _mxu_rank_generate_bricks(
+                local_data, origin, spacing, cam, slicer, spec, tf,
+                vdi_cfg, axis, n, bricks, threshold=thr)
+            return (_composite_exchanged(vdi.color, vdi.depth, n, axis,
+                                         comp_cfg, topo=topo), meta,
+                    thr2, None)
         if waves:
             out, meta, _, thr2, ru2 = _mxu_rank_generate_waves(
                 local_data, origin, spacing, cam, slicer, spec, tf,
@@ -1330,21 +1652,31 @@ def distributed_initial_threshold_mxu(mesh: Mesh, tf: TransferFunction,
                                       spec,
                                       vdi_cfg: Optional[VDIConfig] = None,
                                       axis_name: Optional[str] = None,
-                                      plan=None):
+                                      plan=None, bricks=None):
     """Jitted seeder for `distributed_vdi_step_mxu_temporal`: one
     histogram counting march per rank on its own slab. Returns
     ``f(vol_data (z-sharded), origin, spacing, cam) -> ThresholdState``
-    with rank-stacked [n*nj, ni] maps."""
+    with rank-stacked [n*nj, ni] maps (``bricks``: one map set per
+    brick slot, row-stacked like the step carries them)."""
     from scenery_insitu_tpu.ops import slicer
 
     vdi_cfg = vdi_cfg or VDIConfig()
     axis, n, _ = resolve_mesh_topology(mesh, axis_name)
     # the seeding march must run the SAME render decomposition the step
     # it seeds will march (no CompositeConfig here, so the mode is
-    # implied by the plan itself)
+    # implied by the plan/brick map itself)
     plan = _resolve_plan("occupancy", n, plan)
+    bricks = _resolve_bricks("bricks", n, bricks)
 
     def seed(local_data, origin, spacing, cam: Camera):
+        if bricks is not None:
+            units, gmax, _ = _brick_units(local_data, origin, spacing,
+                                          spec, axis, n, bricks)
+            return _stack_thr([
+                slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg,
+                                         box_min=origin, box_max=gmax,
+                                         v_bounds=vb, w_bounds=wb)
+                for vol, vb, wb in units])
         vol, gmax, v_bounds, w_bounds, _ = _rank_slab(
             local_data, origin, spacing, spec, axis, n, plan=plan)
         return slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg,
@@ -1364,7 +1696,8 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
                                       comp_cfg: Optional[CompositeConfig]
                                       = None,
                                       axis_name: Optional[str] = None,
-                                      plan=None, reuse_tol: float = 0.0,
+                                      plan=None, bricks=None,
+                                      reuse_tol: float = 0.0,
                                       topology=None):
     """`distributed_vdi_step_mxu` with carried per-rank temporal threshold
     state (adaptive_mode="temporal": ONE march per rank per frame instead
@@ -1379,8 +1712,8 @@ def distributed_vdi_step_mxu_temporal(mesh: Mesh, tf: TransferFunction,
     return (see `distributed_vdi_step_mxu`).
     """
     return _build_mxu_step(mesh, tf, spec, vdi_cfg, comp_cfg, axis_name,
-                           temporal=True, plan=plan, reuse_tol=reuse_tol,
-                           topology=topology)
+                           temporal=True, plan=plan, bricks=bricks,
+                           reuse_tol=reuse_tol, topology=topology)
 
 
 def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
@@ -1390,7 +1723,7 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
                                 colormap: str = "jet",
                                 axis_name: Optional[str] = None,
                                 temporal: bool = False,
-                                plan=None, topology=None):
+                                plan=None, bricks=None, topology=None):
     """Distributed hybrid volume+particle frame (BASELINE.md Config 5):
     z-sharded volume through the sort-last MXU VDI chain, N-sharded
     tracers through the sort-first splat chain (per-rank z-buffer,
@@ -1424,6 +1757,7 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
                          f"mesh size {n}")
     waves = _resolve_waves(comp_cfg, n, spec.ni, slicer)
     plan = _resolve_plan(comp_cfg, n, plan)
+    _bricks_inert(bricks, "the hybrid step")
     # the hybrid frame re-splats particles every frame anyway; carrying
     # the VDI half's fragments is future work — say so, don't ignore
     _resolve_reuse(comp_cfg, supported=False, where="the hybrid step")
@@ -1516,8 +1850,10 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                rebalance_hysteresis: float = 0.25,
                                rebalance_min_depth: int = 4,
                                rebalance_quantum: int = 4,
+                               rebalance_bricks: int = 0,
+                               rebalance_max_moves: int = 2,
                                temporal_reuse: str = "off",
-                               plan=None, topology=None):
+                               plan=None, bricks=None, topology=None):
     """Distributed plain-image rendering on the MXU slice-march engine —
     the TPU-fast counterpart of `distributed_plain_step` (the reference's
     non-VDI mode, VolumeRaycaster.comp:94-161 composited by
@@ -1566,12 +1902,15 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
                                rebalance_hysteresis=rebalance_hysteresis,
                                rebalance_min_depth=rebalance_min_depth,
                                rebalance_quantum=rebalance_quantum,
+                               rebalance_bricks=rebalance_bricks,
+                               rebalance_max_moves=rebalance_max_moves,
                                temporal_reuse=temporal_reuse)
     waves = _resolve_waves(knob_cfg, n, spec.ni, slicer)
     # a planned band must be at least as deep as the AO shade halo
     plan = _resolve_plan(knob_cfg, n, plan,
                          min_halo=(cfg.ao_radius + 1
                                    if cfg.ao_strength > 0.0 else 1))
+    _bricks_inert(bricks, "the plain-image MXU step")
     _resolve_reuse(knob_cfg, supported=False,
                    where="the plain-image MXU step")
 
@@ -1656,8 +1995,10 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                            rebalance_hysteresis: float = 0.25,
                            rebalance_min_depth: int = 4,
                            rebalance_quantum: int = 4,
+                           rebalance_bricks: int = 0,
+                           rebalance_max_moves: int = 2,
                            temporal_reuse: str = "off",
-                           plan=None, topology=None):
+                           plan=None, bricks=None, topology=None):
     """Build the jitted distributed plain-image render step (the reference's
     non-VDI mode: VolumeRaycaster + PlainImageCompositor,
     DistributedVolumeRenderer.kt:175-189). Returns ``f(vol_data, origin,
@@ -1677,11 +2018,14 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
                                rebalance_hysteresis=rebalance_hysteresis,
                                rebalance_min_depth=rebalance_min_depth,
                                rebalance_quantum=rebalance_quantum,
+                               rebalance_bricks=rebalance_bricks,
+                               rebalance_max_moves=rebalance_max_moves,
                                temporal_reuse=temporal_reuse)
     waves = _resolve_waves(knob_cfg, n, width)
     plan = _resolve_plan(knob_cfg, n, plan,
                          min_halo=(cfg.ao_radius + 1
                                    if cfg.ao_strength > 0.0 else 1))
+    _bricks_inert(bricks, "the plain-image gather step")
     _resolve_reuse(knob_cfg, supported=False,
                    where="the plain-image gather step")
 

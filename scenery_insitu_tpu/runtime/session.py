@@ -43,24 +43,32 @@ from scenery_insitu_tpu.sim import vortex as vx
 Sink = Callable[[int, dict], None]
 
 
-def drain_steering(sess) -> None:
-    """Apply all pending steering messages to ``sess`` (camera updates in
-    place, other kinds to the on_steer callbacks). Shared by InSituSession
-    and SceneSession so the steering protocol has ONE consumer.
+def steer_session(sess, msg: dict) -> None:
+    """Apply ONE steering-protocol message to ``sess`` (camera updates
+    in place, other kinds to the on_steer callbacks). The zmq drain and
+    the in-process path (scenario steering hooks —
+    scenery_insitu_tpu/scenarios) route through this same consumer.
 
     on_steer callbacks run behind the session's SinkGuard: an exception
     in one callback must not kill the drain (or the run) — a callback
     failing ``fault.max_sink_failures`` consecutive times is quarantined
     on the ``session.sink`` ledger."""
+    from scenery_insitu_tpu.runtime.streaming import apply_steering
+    sess.camera, other = apply_steering(sess.camera, msg)
+    for kind_msg in other.values():
+        sess._sink_guard.run(sess.on_steer, kind_msg,
+                             kind="on_steer callback")
+
+
+def drain_steering(sess) -> None:
+    """Apply all pending steering messages to ``sess``. Shared by
+    InSituSession and SceneSession so the steering protocol has ONE
+    consumer (`steer_session`)."""
     if sess.steering is None:
         return
-    from scenery_insitu_tpu.runtime.streaming import apply_steering
     with sess.obs.span("steer", frame=sess.frame_index):
         for msg in sess.steering.drain():
-            sess.camera, other = apply_steering(sess.camera, msg)
-            for kind_msg in other.values():
-                sess._sink_guard.run(sess.on_steer, kind_msg,
-                                     kind="on_steer callback")
+            steer_session(sess, msg)
 
 
 def apply_tf_steering(sess, msg: dict, invalidate) -> None:
@@ -81,6 +89,19 @@ def apply_tf_steering(sess, msg: dict, invalidate) -> None:
         return
     sess.tf = tf
     invalidate()
+
+
+def _tf_fingerprint(tf) -> str:
+    """Content identity of a TransferFunction (knot arrays hashed) —
+    the recompile-or-reuse cache key of steered TF updates
+    (docs/SCENARIOS.md "Steered transfer functions"): two messages
+    describing the same polyline map to the same compiled steps."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for leaf in tf:
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()[:16]
 
 
 def regime_camera(cam0, regime, slicer_mod):
@@ -317,9 +338,17 @@ class InSituSession:
         self.frame_index = 0
         # render rebalancing (docs/PERF.md "Render rebalancing"): the
         # current planned z-band depths per rank (None = even split) and
-        # the frame of the last host-side re-plan; see _maybe_replan
+        # the frame of the last host-side re-plan; see _maybe_replan.
+        # rebalance="bricks" keeps a BrickMap instead (docs/SCENARIOS.md
+        # "Brick maps": non-convex brick→rank assignment, re-planned by
+        # brick-stealing)
         self._plan = None
+        self._bricks = None
         self._plan_frame = None
+        # steered-TF recompile-or-reuse (docs/SCENARIOS.md "Steered
+        # transfer functions"): compiled-step caches stashed under the
+        # outgoing TF's identity key, restored when a steered TF repeats
+        self._step_cache = {}
         self.orbit_rate = 0.0  # radians/frame camera sweep (benchmark mode)
         self.steering = None   # optional streaming.SteeringEndpoint
         self.on_steer: List[Callable[[dict], None]] = []  # non-camera msgs
@@ -346,6 +375,16 @@ class InSituSession:
             self._origin = jnp.asarray(
                 [-w * vox / 2, -h * vox / 2, -d * vox / 2], jnp.float32)
             self._spacing = jnp.full((3,), vox, jnp.float32)
+            cc = self.cfg.composite
+            if cc.rebalance == "bricks" and cc.rebalance_bricks \
+                    and int(d) % cc.rebalance_bricks:
+                # impossible geometry must fail at session build, not
+                # minutes in at the first replan (BrickMap would reject
+                # it there; the knob is the fix to name)
+                raise ValueError(
+                    f"composite.rebalance_bricks={cc.rebalance_bricks} "
+                    f"does not divide the volume depth {int(d)} (use 0 "
+                    f"for auto, or a divisor)")
 
     def _build_steps(self) -> None:
         """(Re)build the distributed steps for the current mode/engine/TF
@@ -362,6 +401,7 @@ class InSituSession:
         self._mxu_reuse = {}   # regime key -> temporal-reuse ReuseState
         self._scan_steps = {}  # (kind, regime, block) -> scan executable
         self._profile_fn = None  # jitted z-live-profile fetch (replan)
+        self._tf_key = _tf_fingerprint(self.tf)
         self.mode = "vdi"
         if isinstance(self.sim, ParticleSimAdapter):
             # sort-first sphere rendering (≅ InVisRenderer + Head)
@@ -384,7 +424,8 @@ class InSituSession:
             self._step = distributed_vdi_step(
                 self.mesh, self.tf, r.width, r.height,
                 self.cfg.vdi, self.cfg.composite, max_steps=r.max_steps,
-                plan=self._plan, topology=self.cfg.topology)
+                plan=self._plan, bricks=self._bricks,
+                topology=self.cfg.topology)
         elif self.engine == "mxu":
             # TPU plain mode: slice march + column exchange + nearest-first
             # composite on the intermediate grid, homography-warped to the
@@ -406,8 +447,11 @@ class InSituSession:
                 rebalance_hysteresis=cc.rebalance_hysteresis,
                 rebalance_min_depth=cc.rebalance_min_depth,
                 rebalance_quantum=cc.rebalance_quantum,
+                rebalance_bricks=cc.rebalance_bricks,
+                rebalance_max_moves=cc.rebalance_max_moves,
                 temporal_reuse=cc.temporal_reuse,
-                plan=self._plan, topology=self.cfg.topology)
+                plan=self._plan, bricks=self._bricks,
+                topology=self.cfg.topology)
 
         self._temporal = (self.cfg.vdi.adaptive
                           and self.cfg.vdi.adaptive_mode == "temporal"
@@ -418,9 +462,11 @@ class InSituSession:
         # modes' builders (gather/hybrid/plain) ledger the knob inert,
         # and the particle step never consults CompositeConfig at all —
         # say so here rather than silently rendering every frame
+        # brick-partitioned marches carry no reuse plumbing — the builder
+        # ledgers the inert knob (delta.reuse) when a map is active
         self._reuse = (self.cfg.composite.temporal_reuse == "ranges"
                        and self.mode == "vdi" and self.engine == "mxu"
-                       and self._step is None)
+                       and self._step is None and self._bricks is None)
         if self.cfg.composite.temporal_reuse == "ranges" \
                 and not self._reuse and self.mode == "particles":
             _obs.degrade("delta.reuse", "ranges", "off",
@@ -439,11 +485,51 @@ class InSituSession:
                 "there")
 
     def _apply_tf_message(self, msg: dict) -> None:
-        """'tf' steering: rebuild the compiled steps with the new TF (knot
-        arrays are fixed-shape, so pipeline shapes never change; the
-        recompile and temporal re-seed are the cost of a rare user
-        action). Shared protocol logic lives in `apply_tf_steering`."""
-        apply_tf_steering(self, msg, self._build_steps)
+        """'tf' steering: swap the TF and recompile-OR-REUSE (knot
+        arrays are fixed-shape, so pipeline shapes never change). Shared
+        protocol logic lives in `apply_tf_steering`."""
+        apply_tf_steering(self, msg, self._tf_invalidate)
+
+    def _decomp_key(self):
+        """The render-decomposition half of the step-cache key — cached
+        steps bake the plan / brick map in as build-time geometry."""
+        return (self._plan,
+                None if self._bricks is None else self._bricks.owner)
+
+    def _tf_invalidate(self) -> None:
+        """Steered-TF recompile-or-reuse keyed on TF identity
+        (docs/SCENARIOS.md "Steered transfer functions"): the outgoing
+        TF's compiled steps are stashed under its fingerprint, and a
+        steered TF seen before (same knots, same render decomposition)
+        restores them instead of recompiling — a time-varying TF
+        schedule cycling through k looks pays k compiles total, not one
+        per update. Carried temporal threshold / reuse state re-seeds
+        either way (it tracks scene content under the OLD TF)."""
+        old_key = (self._tf_key,) + self._decomp_key()
+        self._step_cache[old_key] = (self._mxu_steps, self._scan_steps,
+                                     self._step, self._profile_fn)
+        while len(self._step_cache) > 8:        # bound compiled-step pins
+            self._step_cache.pop(next(iter(self._step_cache)))
+        new_fp = _tf_fingerprint(self.tf)
+        self.obs.count("tf_updates")
+        entry = self._step_cache.get((new_fp,) + self._decomp_key())
+        if entry is not None:
+            (self._mxu_steps, self._scan_steps, self._step,
+             self._profile_fn) = entry
+            self._mxu_thr = {}
+            self._mxu_reuse = {}
+            self._tf_key = new_fp
+            self.obs.count("tf_steps_reused")
+            self.obs.event("tf_update", frame=self.frame_index,
+                           reused=True, key=new_fp)
+            return
+        self.obs.event("tf_update", frame=self.frame_index, reused=False,
+                       key=new_fp)
+        _obs.degrade("scenario.tf_update", "compiled steps", "recompile",
+                     "a steered transfer function not seen before "
+                     "rebuilds the compiled steps (TF knots are "
+                     "compile-time constants)", warn=False)
+        self._build_steps()
 
     # ------------------------------------------------------------- frames
 
@@ -643,7 +729,7 @@ class InSituSession:
         event carrying the slice histogram and modeled straggler
         factors."""
         cc = self.cfg.composite
-        if cc.rebalance != "occupancy":
+        if cc.rebalance not in ("occupancy", "bricks"):
             return
         n = self._n_ranks
         if self.mode == "particles" or not hasattr(self.sim, "field") \
@@ -656,8 +742,21 @@ class InSituSession:
                  f"mode {self.mode!r} renders no volume field to "
                  "rebalance"), warn=False)
             return
+        if cc.rebalance == "bricks" and self.mode != "vdi":
+            # only the gather/MXU VDI builders consume a brick map —
+            # replanning here would recompile hybrid/plain steps that
+            # ledger the map inert and render even slabs regardless
+            _obs.degrade(
+                "bricks.partition", "bricks", "slabs",
+                f"mode {self.mode!r} has no brick march (gather/MXU VDI "
+                "steps only); the even z-slab decomposition renders",
+                warn=False)
+            return
         if self._plan_frame is not None and \
                 self.frame_index - self._plan_frame < cc.rebalance_period:
+            return
+        if cc.rebalance == "bricks":
+            self._replan_bricks(cc, n)
             return
         from scenery_insitu_tpu.ops import occupancy as _occ
 
@@ -685,6 +784,48 @@ class InSituSession:
                      "render bands re-planned from fetched live "
                      "fractions; affected steps recompile", warn=False)
         self._plan = plan if plan != even else None
+        self._build_steps()
+
+    def _replan_bricks(self, cc, n: int) -> None:
+        """Brick-stealing re-plan (CompositeConfig.rebalance == "bricks";
+        docs/SCENARIOS.md "Brick maps"): bin the fetched z live profile
+        into per-brick work and greedily move at most
+        ``rebalance_max_moves`` bricks from the most- to the least-loaded
+        rank (parallel.bricks.steal_plan, hysteresis-stable). An adopted
+        map change drops the compiled steps exactly like a slab replan;
+        a map that converges back to the even-convex assignment restores
+        the brickless fast path."""
+        from scenery_insitu_tpu.parallel import bricks as _bk
+
+        d = int(self.sim.field.shape[0])
+        with self.obs.span("replan", frame=self.frame_index):
+            profile = self._replan_profile()
+            nb = cc.rebalance_bricks or _bk.auto_nbricks(d, n)
+            work = _bk.brick_work(profile, d, nb)
+            seed = _bk.BrickMap.contiguous(d, n, nb)
+            prev = (self._bricks if self._bricks is not None
+                    and self._bricks.nbricks == nb else seed)
+            bm = _bk.steal_plan(prev, work,
+                                max_moves=cc.rebalance_max_moves,
+                                hysteresis=cc.rebalance_hysteresis)
+        self._plan_frame = self.frame_index
+        new = None if bm.is_even_convex() else bm
+        cur = self._bricks
+        if (new is None) == (cur is None) and \
+                (new is None or new.owner == cur.owner):
+            return                      # stable — nothing recompiles
+        self.obs.count("rebalance_replans")
+        self.obs.event(
+            "rebalance_plan", frame=self.frame_index, kind="bricks",
+            nbricks=nb, owner=list(bm.owner),
+            straggler_even=round(_bk.straggler_factor(seed, work), 3),
+            straggler_planned=round(_bk.straggler_factor(bm, work), 3))
+        _obs.degrade("occupancy.replan",
+                     f"bricks{tuple(prev.owner)}",
+                     f"bricks{tuple(bm.owner)}",
+                     "brick ownership re-planned from fetched live "
+                     "fractions; affected steps recompile", warn=False)
+        self._bricks = new
         self._build_steps()
 
     def _enter_regime(self, key) -> None:
@@ -775,15 +916,15 @@ class InSituSession:
                 if self._temporal:
                     step = distributed_vdi_step_mxu_temporal(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        comp_cfg, plan=self._plan,
+                        comp_cfg, plan=self._plan, bricks=self._bricks,
                         topology=self.cfg.topology)
                     seed = distributed_initial_threshold_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        plan=self._plan)
+                        plan=self._plan, bricks=self._bricks)
                 else:
                     step = distributed_vdi_step_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
-                        comp_cfg, plan=self._plan,
+                        comp_cfg, plan=self._plan, bricks=self._bricks,
                         topology=self.cfg.topology)
                     seed = None
             steps_per_frame = self.cfg.sim.steps_per_frame
@@ -1023,7 +1164,7 @@ class InSituSession:
                 self.mesh, self.tf, spec, self.cfg.vdi, self.cfg.composite,
                 radius=self.cfg.sim.particle_radius * float(self._spacing[0]),
                 stamp=5, temporal=self._temporal, plan=self._plan,
-                topology=self.cfg.topology)
+                bricks=self._bricks, topology=self.cfg.topology)
             seed = (distributed_initial_threshold_mxu(
                         self.mesh, self.tf, spec, self.cfg.vdi,
                         plan=self._plan)
@@ -1089,8 +1230,11 @@ class InSituSession:
                 rebalance_hysteresis=cc.rebalance_hysteresis,
                 rebalance_min_depth=cc.rebalance_min_depth,
                 rebalance_quantum=cc.rebalance_quantum,
+                rebalance_bricks=cc.rebalance_bricks,
+                rebalance_max_moves=cc.rebalance_max_moves,
                 temporal_reuse=cc.temporal_reuse,
-                plan=self._plan, topology=self.cfg.topology)
+                plan=self._plan, bricks=self._bricks,
+                topology=self.cfg.topology)
             r = self.cfg.render
             slicer = self._slicer
 
@@ -1136,11 +1280,12 @@ class InSituSession:
             if self._temporal:
                 inner = distributed_vdi_step_mxu_temporal(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    self.cfg.composite, plan=self._plan, reuse_tol=tol,
+                    self.cfg.composite, plan=self._plan,
+                    bricks=self._bricks, reuse_tol=tol,
                     topology=self.cfg.topology)
                 seed = distributed_initial_threshold_mxu(
                     self.mesh, self.tf, spec, self.cfg.vdi,
-                    plan=self._plan)
+                    plan=self._plan, bricks=self._bricks)
 
                 def step(field, origin, spacing, cam,
                          _regime=regime, _inner=inner, _seed=seed,
@@ -1166,6 +1311,8 @@ class InSituSession:
                     self.mesh, self.tf, spec, self.cfg.vdi,
                     self.cfg.composite, plan=self._plan, reuse_tol=tol,
                     topology=self.cfg.topology)
+                # (bricks force _reuse off at _build_steps, so this
+                # branch never carries a brick map)
 
                 def step(field, origin, spacing, cam,
                          _regime=regime, _inner=inner, _rseed=rseed):
@@ -1181,7 +1328,7 @@ class InSituSession:
                 step = distributed_vdi_step_mxu(
                     self.mesh, self.tf, spec, self.cfg.vdi,
                     self.cfg.composite, plan=self._plan,
-                    topology=self.cfg.topology)
+                    bricks=self._bricks, topology=self.cfg.topology)
             self._mxu_steps[regime] = step
         return step
 

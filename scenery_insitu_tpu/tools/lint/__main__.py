@@ -14,6 +14,10 @@ Options:
                      "TODO: justify or fix" (then exit 1 until edited)
   --report PATH      write the full JSON report (diagnostics + baseline
                      accounting) — uploaded as a CI artifact
+  --fail-on-stale    exit 1 when baseline entries no longer match any
+                     finding (CI uses this: paid-off debt must be
+                     PRUNED from the baseline, not linger as dead rows
+                     that could silently re-absorb a regression)
   paths              files/dirs to scan (default: the package minus
                      tools/, bench.py, benchmarks/)
 """
@@ -39,6 +43,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--report", default=None)
+    ap.add_argument("--fail-on-stale", action="store_true")
     ap.add_argument("paths", nargs="*")
     args = ap.parse_args(argv)
 
@@ -91,6 +96,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if new:
         print(f"sitpu-lint: {len(new)} new finding(s) "
               f"({len(accepted)} baselined)")
+        return 1
+    if stale and args.fail_on_stale:
+        print(f"sitpu-lint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} — prune them "
+              f"(--fail-on-stale)")
         return 1
     print(f"sitpu-lint: clean ({len(accepted)} baselined finding(s), "
           f"{len(srcs)} files)")

@@ -104,6 +104,7 @@ class TestThread:
                               "wave_tiles", "k_budget", "rebalance",
                               "rebalance_period", "rebalance_hysteresis",
                               "rebalance_min_depth", "rebalance_quantum",
+                              "rebalance_bricks", "rebalance_max_moves",
                               "temporal_reuse"}
 
     def test_deleted_wire_forwarding_fails(self):
@@ -244,6 +245,25 @@ class TestBaseline:
         # stale entries are reported once the finding disappears
         _, _, stale = bl.split(diags[1:])
         assert len(stale) == 1
+
+    def test_cli_fail_on_stale(self, tmp_path):
+        """ISSUE 15 satellite: with --fail-on-stale a baseline entry
+        that no longer matches any finding FAILS the gate instead of
+        lingering as a dead row (CI runs the flag)."""
+        from scenery_insitu_tpu.tools.lint.__main__ import main as cli
+
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        bl = tmp_path / "bl.json"
+        Baseline([{"code": "SITPU-LEDGER", "path": "gone.py",
+                   "message": "long since fixed", "symbol": "f",
+                   "reason": "a debt that was paid off and never pruned"
+                   }]).save(str(bl))
+        args = ["--baseline", str(bl), str(clean)]
+        assert cli(args) == 0                     # stale alone passes...
+        assert cli(["--fail-on-stale"] + args) == 1   # ...the flag gates
+        # and the committed baseline stays stale-free under the flag
+        assert cli(["--fail-on-stale"]) == 0
 
     def test_reasons_are_mandatory(self):
         with pytest.raises(ValueError, match="without a reason"):

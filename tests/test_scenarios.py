@@ -1,0 +1,139 @@
+"""The scenario zoo (scenery_insitu_tpu/scenarios; docs/SCENARIOS.md):
+registry mechanics, the steered end-to-end smokes that promote the
+vortex / hybrid / Lennard-Jones sims from orphan demos to tier-1
+workloads, and the steered-TF recompile-or-reuse contract (a tf update
+cycling through k distinct looks pays k compiles total)."""
+
+import jax
+import numpy as np
+import pytest
+
+from scenery_insitu_tpu import obs, scenarios
+
+TINY = ("sim.grid=[16,16,16]", "sim.steps_per_frame=1",
+        "render.width=32", "render.height=32")
+
+
+def test_registry_names_and_lookup():
+    names = scenarios.names()
+    for expected in ("gray_scott", "vortex", "hybrid", "lennard_jones"):
+        assert expected in names
+    with pytest.raises(ValueError, match="unknown scenario"):
+        scenarios.get("nope")
+    with pytest.raises(ValueError, match="already registered"):
+        scenarios.register(scenarios.get("vortex"))
+
+
+def test_make_config_applies_overrides():
+    cfg = scenarios.make_config("vortex",
+                                extra_overrides=("sim.grid=[8,8,8]",))
+    assert cfg.sim.kind == "vortex"
+    assert cfg.runtime.dataset == "vortex"
+    assert cfg.sim.grid == (8, 8, 8)
+    cfg = scenarios.make_config("hybrid")
+    assert cfg.sim.kind == "hybrid"
+
+
+def test_tf_schedule_and_dolly_validation():
+    with pytest.raises(ValueError):
+        scenarios.tf_schedule([], period=3)
+    msgs = [{"type": "tf", "points": [[0.0, 0.0], [1.0, 0.5]],
+             "colormap": "hot"}]
+    hook = scenarios.tf_schedule(msgs, period=2)
+
+    class _S:
+        pass
+
+    assert hook(_S(), 0) is None          # frame 0 keeps the session TF
+    assert hook(_S(), 1) is None
+    assert hook(_S(), 2) is msgs[0]
+
+
+def test_vortex_scenario_steered_end_to_end():
+    """Vortex runs through the full session with its TF schedule firing
+    over the steering consumer — a registered workload, not a demo."""
+    scn = scenarios.get("vortex")
+    sess = scenarios.make_session(
+        "vortex", extra_overrides=TINY + ("slicer.engine=gather",
+                                          "obs.enabled=true"))
+    payload = scenarios.run_steered(sess, scn, 7)
+    assert {"vdi_color", "vdi_depth", "meta"} <= set(payload)
+    assert payload["frame"] == 6
+    assert np.isfinite(payload["vdi_color"]).all()
+    # the period-3 schedule fired at frames 3 and 6
+    assert sess.obs.counters.get("tf_updates", 0) == 2
+
+
+def test_tf_update_recompile_or_reuse():
+    """Cycling 2 TFs over 13 frames: 4 updates, but only 2 distinct
+    looks compile — the later updates restore cached steps
+    (tf_steps_reused), and the first-contact recompiles land on the
+    scenario.tf_update ledger."""
+    obs.clear_ledger()
+    scn = scenarios.get("vortex")
+    sess = scenarios.make_session(
+        "vortex", extra_overrides=TINY + ("slicer.engine=gather",
+                                          "obs.enabled=true"))
+    scenarios.run_steered(sess, scn, 13)
+    assert sess.obs.counters.get("tf_updates", 0) == 4
+    assert sess.obs.counters.get("tf_steps_reused", 0) == 2
+    # initial build + one per DISTINCT steered TF
+    assert sess.obs.counters.get("build_steps", 0) == 3
+    assert any(e["component"] == "scenario.tf_update"
+               for e in obs.ledger())
+    reused = [e for e in sess.obs.events if e.get("name") == "tf_update"
+              and e["attrs"].get("reused")]
+    assert len(reused) == 2
+
+
+def test_hybrid_scenario_multi_volume_smoke():
+    """The multi-volume scene: vortex grid field + sort-first tracer
+    splats composited in ONE frame (ops/hybrid.py) through the session,
+    by name."""
+    scn = scenarios.get("hybrid")
+    sess = scenarios.make_session(
+        "hybrid", extra_overrides=TINY + ("sim.num_particles=64",))
+    assert sess.mode == "hybrid"
+    payload = scenarios.run_steered(sess, scn, 2)
+    img = payload["image"]
+    assert img.shape == (4, 32, 32)
+    assert np.isfinite(img).all()
+    assert float(np.abs(img).sum()) > 0.0
+
+
+def test_lennard_jones_scenario_camera_steering():
+    """The MD particle scenario renders sort-first splats and its
+    camera-dolly steering hook actually moves the camera through the
+    protocol path."""
+    scn = scenarios.get("lennard_jones")
+    sess = scenarios.make_session(
+        "lennard_jones",
+        extra_overrides=("sim.num_particles=256", "render.width=32",
+                         "render.height=32", "sim.steps_per_frame=1"))
+    assert sess.mode == "particles"
+    eye0 = np.asarray(sess.camera.eye).copy()
+    payload = scenarios.run_steered(sess, scn, 3)
+    assert {"image", "depth"} <= set(payload)
+    assert not np.allclose(np.asarray(sess.camera.eye), eye0)
+
+
+def test_run_one_call():
+    payload = scenarios.run(
+        "gray_scott", 2,
+        extra_overrides=TINY + ("slicer.engine=gather",))
+    assert "vdi_color" in payload
+
+
+def test_steer_session_camera_message():
+    from scenery_insitu_tpu.runtime.session import steer_session
+
+    sess = scenarios.make_session(
+        "gray_scott", extra_overrides=TINY + ("slicer.engine=gather",))
+    steer_session(sess, {"type": "camera", "eye": [0.5, 0.5, 2.0]})
+    np.testing.assert_allclose(np.asarray(sess.camera.eye),
+                               [0.5, 0.5, 2.0])
+    seen = []
+    sess.on_steer.append(lambda m: seen.append(m))
+    steer_session(sess, {"type": "custom", "x": 1})
+    assert seen and seen[0]["x"] == 1
+    jax.block_until_ready(sess.render_frame())

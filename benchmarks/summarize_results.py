@@ -166,6 +166,22 @@ def fmt(r: dict) -> str:
         if r.get("note"):
             lines.append(f"  note: {r['note']}")
         return "\n   ".join(lines)
+    if str(r.get("metric", "")).startswith("lod_ladder"):
+        # multi-resolution march ladder (watcher step 16)
+        sc = r.get("scene", {})
+        lines = [f"{r['metric']}: x{r.get('value')} modeled march FLOPs "
+                 f"at {r.get('psnr_db')} dB (floor "
+                 f"{r.get('psnr_floor_db')} dB, error_px="
+                 f"{r.get('best_error_px')}; {sc.get('nbricks')} bricks)"]
+        for rung in r.get("ladder", []):
+            hist = rung.get("level_hist") or {"0": len(rung["levels"])}
+            hist_s = " ".join(f"L{k}:{v}" for k, v in sorted(hist.items()))
+            lines.append(
+                f"  err={str(rung.get('error_px')):>4s}px  "
+                f"{str(rung.get('psnr_db')):>7s} dB  "
+                f"x{rung.get('flop_reduction')} flops  "
+                f"{rung.get('frame_ms')} ms  [{hist_s}]")
+        return "\n   ".join(lines)
     if r.get("metric") == "serve_bench":          # edge-serving tier
         am = r.get("amortization", {})
         lines = [f"serve_bench: [{r.get('platform', '?')}] per-viewer "

@@ -160,6 +160,23 @@ run_step() {
          python benchmarks/rank_slab_bench.py --rebalance all \
          --grid 256 --iters 3 \
          --out "$R/bricks_ab_tpu_${ROUND}.json" ;;
+    # LOD marching ladder on a real chip: PSNR vs modeled march FLOPs
+    # vs MEASURED ms/frame at 512^3, where per-brick fixed cost no
+    # longer hides the 2^-l march saving (docs/PERF.md "LOD marching";
+    # the committed CPU capture is lod_ab_r16_cpu — its frame_ms
+    # column is the toy-grid caveat this step exists to replace)
+    16) run_json "$R/lod_ab_tpu_${ROUND}.json" 1800 \
+         python benchmarks/lod_bench.py --grid 512 --iters 3 \
+         --out "$R/lod_ab_tpu_${ROUND}.json" ;;
+    # the 2048^3 coarse-heavy attempt (ISSUE 16 / ROADMAP item 3's
+    # "honest route past 1024^3"): max_level 3, generous error budgets
+    # — most bricks should coarsen, which is the only way this grid
+    # fits a march budget. Like step 10, a diagnosed OOM is a result.
+    17) run_json "$R/lod_2048_tpu_${ROUND}.json" 2400 env \
+         SITPU_BENCH_CHILD_TIMEOUT=2100 \
+         python benchmarks/lod_bench.py --grid 2048 --iters 1 \
+         --max-level 3 --ladder 4.0 8.0 16.0 --k 8 \
+         --out "$R/lod_2048_tpu_${ROUND}.json" ;;
   esac
 }
 
@@ -180,10 +197,12 @@ step_out() {
     13) echo "$R/serve_bench_tpu_${ROUND}.json" ;;
     14) echo "$R/hier_device_tpu_${ROUND}.json" ;;
     15) echo "$R/bricks_ab_tpu_${ROUND}.json" ;;
+    16) echo "$R/lod_ab_tpu_${ROUND}.json" ;;
+    17) echo "$R/lod_2048_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=15
+NSTEPS=17
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

@@ -372,6 +372,72 @@ class CompositeConfig:
 
 
 @dataclass(frozen=True)
+class LODConfig:
+    """Multi-resolution brick marching (docs/PERF.md "LOD marching";
+    docs/SCENARIOS.md "LOD levels").
+
+    Rides the brick render decomposition (``composite.rebalance ==
+    "bricks"``): each brick of the map carries a refinement ``level``
+    (parallel/bricks.BrickMap.level) chosen host-side at every replan
+    (parallel/lod.py) from the occupancy profile, a conservative
+    screen-space error bound from the camera, and the transfer-function
+    straddle gate. A level-``l`` brick marches a ``2^l``-downsampled
+    copy (average-pooled on device at materialization,
+    parallel/mesh.reslab_bricks_lod) through the same `slice_march`
+    machinery at ``step_scale = 2^-l``; its supersegments composite
+    unchanged. An all-level-0 map is BITWISE the pre-LOD brick path.
+    Enabled without a brick map, the knob is inert and ledgered
+    (lod.inert). The MXU VDI march is the only coarse consumer — the
+    gather engine samples fine and ledgers the map's levels inert
+    (lod.engine)."""
+
+    # Master switch: select per-brick refinement levels at every brick
+    # replan. False = every brick stays level 0 (the flat PR-15 map).
+    enabled: bool = False
+    # Deepest refinement level a brick may coarsen to (downsample factor
+    # 2^max_level). The planner additionally caps levels so 2^l divides
+    # the brick depth and both in-plane extents.
+    max_level: int = 2
+    # Screen-space error budget, intermediate-grid pixels: a brick may
+    # coarsen to level l only while its projected coarse-voxel footprint
+    # 2^l * voxel * focal_px / eye_distance stays at or below this.
+    error_px: float = 1.0
+    # Coarsen provably-empty bricks (occupancy live fraction at or below
+    # live_eps) to the admissible cap regardless of the screen bound —
+    # air is marched at the coarsest resolution the geometry allows.
+    coarsen_empty: bool = True
+    live_eps: float = 1e-3
+    # Opacity-edge sensitivity of the TF-straddle gate: an alpha knot
+    # with |slope delta| > tf_edge_eps strictly inside a brick's value
+    # range pins that brick at level 0 (never coarsened — downsampling
+    # across a TF edge aliases).
+    tf_edge_eps: float = 1e-4
+    # Coarsening deadband: a brick coarsens (level increases, one level
+    # per replan) only when the coarser footprint also clears
+    # error_px * (1 - hysteresis) — refinement is immediate, coarsening
+    # is damped so a camera at the threshold cannot oscillate the level
+    # tuple (each adopted tuple recompiles the step).
+    hysteresis: float = 0.2
+
+    def __post_init__(self):
+        if not 0 <= self.max_level <= 8:
+            raise ValueError(f"max_level must be in [0, 8], "
+                             f"got {self.max_level}")
+        if self.error_px <= 0.0:
+            raise ValueError(f"error_px must be > 0, "
+                             f"got {self.error_px}")
+        if self.live_eps < 0.0:
+            raise ValueError(f"live_eps must be >= 0, "
+                             f"got {self.live_eps}")
+        if self.tf_edge_eps < 0.0:
+            raise ValueError(f"tf_edge_eps must be >= 0, "
+                             f"got {self.tf_edge_eps}")
+        if not 0.0 <= self.hysteresis < 1.0:
+            raise ValueError(f"hysteresis must be in [0, 1), "
+                             f"got {self.hysteresis}")
+
+
+@dataclass(frozen=True)
 class TopologyConfig:
     """Mesh topology — the scale-out plane (docs/MULTIHOST.md).
 
@@ -728,6 +794,7 @@ class FrameworkConfig:
     fault: FaultConfig = field(default_factory=FaultConfig)
     delta: DeltaConfig = field(default_factory=DeltaConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    lod: LODConfig = field(default_factory=LODConfig)
 
     # ------------------------------------------------------------------ IO
     def to_dict(self) -> dict:

@@ -146,6 +146,21 @@ class TransferFunction(NamedTuple):
         return jnp.maximum(jnp.max(ends, axis=-1), interior)
 
 
+def opacity_edges(tf: TransferFunction, eps: float = 1e-4) -> np.ndarray:
+    """Sorted f32[M] positions of the TF's ACTIVE opacity knots — where
+    the alpha polyline changes slope — host-side (numpy). This is the
+    edge set of the LOD planner's TF-straddle coarsening gate
+    (`parallel.lod.select_levels`; docs/PERF.md "LOD marching"): pooling
+    a brick whose value range crosses one of these positions averages
+    across an opacity feature and can erase or invent it, so such bricks
+    must stay level 0. Padding knots (x = 2, zero slope) and knots whose
+    |slope delta| <= ``eps`` carry no feature and are dropped."""
+    x = np.asarray(tf.alpha_x, np.float32)
+    m = np.asarray(tf.alpha_m, np.float32)
+    keep = (x <= 1.0) & (np.abs(m) > eps)
+    return np.sort(x[keep])
+
+
 def colormap_polyline(name: str) -> Tuple[np.ndarray, np.ndarray]:
     """Built-in colormaps as exact piecewise-linear polylines
     (xs f32[K], rgb f32[K, 3]) (≅ scenery Colormap.get, used with

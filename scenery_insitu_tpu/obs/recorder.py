@@ -127,6 +127,12 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                     "last-good frame until frames resume",
     "io.vdi_codec": "zstd codec unavailable; VDI IO degrades to stdlib "
                     "zlib",
+    "lod.engine": "a multi-level brick map reached the gather engine, "
+                  "which marches every brick at full resolution; levels "
+                  "flatten to 0 (docs/PERF.md 'LOD marching')",
+    "lod.inert": "lod.enabled is set but the session has no brick map "
+                 "(composite.rebalance != bricks), so no per-brick "
+                 "levels exist to plan; the replan is a no-op",
     "multihost.connect": "multihost.initialize could not reach the "
                          "coordinator on an attempt; retrying on the "
                          "bounded backoff ladder instead of hanging "

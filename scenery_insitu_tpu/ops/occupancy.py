@@ -427,6 +427,23 @@ def z_live_profile(field: jnp.ndarray, tf, nzb: int = 0, nyb: int = 0,
     return jnp.mean(live.astype(jnp.float32), axis=1)
 
 
+def z_range_profile(field: jnp.ndarray, nzb: int = 0):
+    """(lo f32[nzb], hi f32[nzb]) per-z-brick sampled value range of a
+    scalar field ``[D, H, W]``, clipped to the TF's [0, 1] domain — the
+    host-side signal of the LOD planner's TF-straddle coarsening gate
+    (`parallel.lod.select_levels`; docs/PERF.md "LOD marching"): a brick
+    whose range crosses an opacity edge must keep level 0, and the
+    decision needs the range itself, not the live reduction
+    `z_live_profile` collapses it to. One `field_ranges` sweep with a
+    single in-plane brick (the gate is per z-brick). In the distributed
+    session each rank profiles its EVEN slab and the ranges concatenate
+    along the mesh axis."""
+    nzb = nzb or default_bricks(field.shape)[0]
+    fr = field_ranges(field, nzb, 1)
+    return (jnp.clip(fr.lo[:, 0], 0.0, 1.0),
+            jnp.clip(fr.hi[:, 0], 0.0, 1.0))
+
+
 def _slice_work(live_profile, d: int, base_cost: float):
     """f64[d] per-slice march work from a per-z-bin live profile
     (``len(live_profile)`` must divide ``d``)."""

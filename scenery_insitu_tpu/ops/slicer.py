@@ -1092,7 +1092,7 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
                      occupancy=None, k_target=None,
                      axcam: Optional[AxisCamera] = None,
                      volp: Optional[jnp.ndarray] = None,
-                     w_bounds=None,
+                     w_bounds=None, step_scale: float = 1.0,
                      ) -> Tuple[VDI, VDIMetadata, AxisCamera]:
     """VDI generation on the MXU slice march (≅ VDIGenerator.comp +
     AccumulateVDI.comp, see ops.vdi_gen for the gather-path equivalent).
@@ -1113,7 +1113,14 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     ``axcam`` overrides the virtual camera (the tile-wave path passes a
     column-sliced `wave_camera` whose u_grid matches ``spec.ni``);
     ``volp`` shares a pre-built `permute_volume` copy across calls (T
-    waves march the same frame copy)."""
+    waves march the same frame copy).
+
+    ``step_scale`` rescales the opacity-correction reference step
+    (`nominal_step(vol, step_scale)`) — the LOD brick path marches a
+    2^l-downsampled volume with ``step_scale = 2^-l`` so coarse slices
+    accumulate the opacity of the 2^l fine slices they replace (the
+    shared reference stays the FINE voxel pitch; docs/PERF.md "LOD
+    marching")."""
     cfg = cfg or VDIConfig()
     k = cfg.max_supersegments
     kt = k if k_target is None else k_target
@@ -1128,7 +1135,8 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
-        occupancy=occ, volp=volp, w_bounds=w_bounds)
+        step_scale=step_scale, occupancy=occ, volp=volp,
+        w_bounds=w_bounds)
 
     if cfg.adaptive and cfg.adaptive_mode == "temporal":
         raise ValueError(
@@ -1173,7 +1181,8 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
 
         packed = slice_march(vol, tf, axcam, spec, consume,
                              psg.init_seg_packed(k, nj, ni),
-                             u_bounds, v_bounds, occupancy=occ,
+                             u_bounds, v_bounds, step_scale=step_scale,
+                             occupancy=occ,
                              shaded_compact=True, volp=volp,
                              w_bounds=w_bounds)
         color, depth = sf.seg_finalize(psg.unpack_seg_state(packed))
@@ -1187,7 +1196,8 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         marcher = (_fused_stream_vdi_march if spec.fold == "fused_stream"
                    else _fused_vdi_march)
         state = marcher(vol, tf, axcam, spec, threshold, k, occ,
-                        u_bounds, v_bounds, volp=volp, w_bounds=w_bounds)
+                        u_bounds, v_bounds, step_scale=step_scale,
+                        volp=volp, w_bounds=w_bounds)
         color, depth = sf.seg_finalize(state)
     elif spec.fold == "seg":
         def consume(st, rgba, t0, t1):
@@ -1204,12 +1214,12 @@ def generate_vdi_mxu(vol: Volume, tf: TransferFunction, cam: Camera,
         state = march(consume, ss.init_state(k, nj, ni))
         color, depth = ss.finalize(state)
 
-    meta = _vdi_meta(vol, axcam, ni, nj, frame_index)
+    meta = _vdi_meta(vol, axcam, ni, nj, frame_index, step_scale)
     return VDI(color, depth), meta, axcam
 
 
 def _vdi_meta(vol: Volume, axcam: AxisCamera, ni: int, nj: int,
-              frame_index: int) -> VDIMetadata:
+              frame_index: int, step_scale: float = 1.0) -> VDIMetadata:
     dims = jnp.asarray(vol.dims_xyz, jnp.float32)
     # model = voxel->world affine (diag spacing + origin): consumers that
     # only get metadata (axis_camera_from_meta) read the per-axis pitch
@@ -1219,7 +1229,8 @@ def _vdi_meta(vol: Volume, axcam: AxisCamera, ni: int, nj: int,
     return VDIMetadata.create(projection=axcam.proj, view=axcam.view,
                               model=model, volume_dims=dims,
                               window_dims=(ni, nj),
-                              nw=nominal_step(vol), index=frame_index)
+                              nw=nominal_step(vol, step_scale),
+                              index=frame_index)
 
 
 def _histogram_threshold(march, cfg: VDIConfig, k: int, nj: int, ni: int,
@@ -1252,20 +1263,25 @@ def initial_threshold(vol: Volume, tf: TransferFunction, cam: Camera,
                       box_max: Optional[jnp.ndarray] = None,
                       u_bounds=None, v_bounds=None,
                       occupancy=None, k_target=None,
-                      w_bounds=None) -> ss.ThresholdState:
+                      w_bounds=None,
+                      axcam: Optional[AxisCamera] = None,
+                      step_scale: float = 1.0) -> ss.ThresholdState:
     """Seed state for the temporal threshold controller ([nj, ni] maps):
     one histogram counting march on the current scene (the same pass
     adaptive_mode="histogram" runs every frame — temporal mode runs it
     once at session start, then `generate_vdi_mxu_temporal` keeps the map
-    in band for one-march frames). ``occupancy``/``k_target``: see
-    `generate_vdi_mxu`."""
+    in band for one-march frames). ``occupancy``/``k_target``/``axcam``/
+    ``step_scale``: see `generate_vdi_mxu` (the LOD brick path passes the
+    shared fine-pitch camera with rescaled dwm)."""
     cfg = cfg or VDIConfig()
-    axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
+    if axcam is None:
+        axcam = make_axis_camera(vol, cam, spec, box_min, box_max)
     volp = permute_volume(vol, spec)
     occ = _resolve_occupancy(vol, tf, spec, occupancy, volp)
     march = lambda consume, carry0: slice_march(
         vol, tf, axcam, spec, consume, carry0, u_bounds, v_bounds,
-        occupancy=occ, volp=volp, w_bounds=w_bounds)
+        step_scale=step_scale, occupancy=occ, volp=volp,
+        w_bounds=w_bounds)
     kt = cfg.max_supersegments if k_target is None else k_target
     thr = _histogram_threshold(march, cfg, kt,
                                spec.nj, spec.ni, spec.fold)
@@ -1283,7 +1299,7 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
                               occupancy=None, k_target=None,
                               axcam: Optional[AxisCamera] = None,
                               volp: Optional[jnp.ndarray] = None,
-                              w_bounds=None,
+                              w_bounds=None, step_scale: float = 1.0,
                               ) -> Tuple[VDI, VDIMetadata, AxisCamera,
                                          ss.ThresholdState]:
     """VDI generation with ONE march per frame (adaptive_mode="temporal").
@@ -1330,8 +1346,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         packed, count = slice_march(
             vol, tf, axcam, spec, consume,
             (pm.init_packed(k, nj, ni), jnp.zeros((nj, ni), jnp.int32)),
-            u_bounds, v_bounds, occupancy=occ, volp=volp,
-            w_bounds=w_bounds)
+            u_bounds, v_bounds, step_scale=step_scale, occupancy=occ,
+            volp=volp, w_bounds=w_bounds)
         color, depth = ss.finalize(pm.unpack_state(packed))
     elif spec.fold in ("seg", "pallas_seg", "pallas_fused",
                        "fused_stream"):
@@ -1343,8 +1359,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
                        if spec.fold == "fused_stream"
                        else _fused_vdi_march)
             state = marcher(vol, tf, axcam, spec, thr, k, occ,
-                            u_bounds, v_bounds, volp=volp,
-                            w_bounds=w_bounds)
+                            u_bounds, v_bounds, step_scale=step_scale,
+                            volp=volp, w_bounds=w_bounds)
         elif spec.fold == "pallas_seg":
             length = axcam.ray_lengths()
 
@@ -1355,7 +1371,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
 
             packed = slice_march(vol, tf, axcam, spec, consume,
                                  psg.init_seg_packed(k, nj, ni),
-                                 u_bounds, v_bounds, occupancy=occ,
+                                 u_bounds, v_bounds,
+                                 step_scale=step_scale, occupancy=occ,
                                  shaded_compact=True, volp=volp,
                                  w_bounds=w_bounds)
             state = psg.unpack_seg_state(packed)
@@ -1365,7 +1382,8 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
 
             state = slice_march(vol, tf, axcam, spec, consume,
                                 sf.init_seg_state(k, nj, ni),
-                                u_bounds, v_bounds, occupancy=occ,
+                                u_bounds, v_bounds,
+                                step_scale=step_scale, occupancy=occ,
                                 volp=volp, w_bounds=w_bounds)
         color, depth = sf.seg_finalize(state)
         count = state.cnt
@@ -1380,12 +1398,12 @@ def generate_vdi_mxu_temporal(vol: Volume, tf: TransferFunction,
         state, cstate = slice_march(
             vol, tf, axcam, spec, consume,
             (ss.init_state(k, nj, ni), ss.init_count(nj, ni)),
-            u_bounds, v_bounds, occupancy=occ, volp=volp,
-            w_bounds=w_bounds)
+            u_bounds, v_bounds, step_scale=step_scale, occupancy=occ,
+            volp=volp, w_bounds=w_bounds)
         color, depth = ss.finalize(state)
         count = cstate.count
     next_thr = ss.update_threshold(threshold, count, kt,
                                    cfg.adaptive_delta, cfg.thr_min,
                                    cfg.thr_max, cfg.temporal_track)
-    meta = _vdi_meta(vol, axcam, ni, nj, frame_index)
+    meta = _vdi_meta(vol, axcam, ni, nj, frame_index, step_scale)
     return VDI(color, depth), meta, axcam, next_thr

@@ -49,11 +49,23 @@ class BrickMap:
     may own zero bricks — their march units come up empty). Per-rank
     brick sets pad to ``slots`` = the busiest rank's count, so one SPMD
     program serves every rank; absent slots are dead (zero rows, empty
-    ownership interval, occupancy admits them as dead)."""
+    ownership interval, occupancy admits them as dead).
+
+    ``level[i]`` is brick ``i``'s refinement level (docs/PERF.md "LOD
+    marching"): level ``l`` marches a ``2^l``-downsampled copy of the
+    brick through the same slice-march machinery (materialized by
+    `parallel.mesh.reslab_bricks_lod`; supersegments composite
+    unchanged — the fragment stream is resolution-agnostic). The empty
+    tuple (the default) normalizes to all-zero, and an all-level-0 map
+    is EXACTLY the flat PR-15 map: every code path, `is_even_convex`
+    included, behaves bitwise as before. For SPMD shape uniformity the
+    builders group march units BY LEVEL (`slots_at`/`start_table_at`):
+    each level present anywhere pads to its own global slot count."""
 
     depth: int
     n_ranks: int
     owner: Tuple[int, ...]
+    level: Tuple[int, ...] = ()
 
     def __post_init__(self):
         owner = tuple(int(o) for o in self.owner)
@@ -72,6 +84,20 @@ class BrickMap:
             raise ValueError(
                 f"brick owners {sorted(set(bad))} outside the "
                 f"{self.n_ranks}-rank mesh (owner table: {owner})")
+        level = tuple(int(l) for l in self.level) or (0,) * nb
+        object.__setattr__(self, "level", level)
+        if len(level) != nb:
+            raise ValueError(
+                f"level table has {len(level)} entries for {nb} bricks")
+        bz = self.depth // nb
+        for i, l in enumerate(level):
+            if l < 0:
+                raise ValueError(f"brick {i} has negative level {l}")
+            if bz % (1 << l):
+                raise ValueError(
+                    f"brick {i} at level {l}: downsample factor "
+                    f"{1 << l} does not divide the {bz}-slice brick "
+                    f"depth (coarse voxels must tile the brick exactly)")
 
     # ------------------------------------------------------------ geometry
     @property
@@ -111,11 +137,63 @@ class BrickMap:
         bz = self.brick_depth
         return [(b * bz, (b + 1) * bz) for b in self.rank_bricks(rank)]
 
+    # ------------------------------------------------------------- levels
+    @property
+    def max_level(self) -> int:
+        return max(self.level)
+
+    def levels_present(self) -> Tuple[int, ...]:
+        """Ascending distinct refinement levels anywhere in the map —
+        GLOBAL, so every rank builds the same per-level unit groups
+        (SPMD shape uniformity; ranks owning no brick at a level march
+        dead slots there)."""
+        return tuple(sorted(set(self.level)))
+
+    def rank_bricks_at(self, rank: int, level: int) -> Tuple[int, ...]:
+        """Ascending brick ids owned by ``rank`` AT ``level``."""
+        return tuple(i for i, (o, l) in enumerate(zip(self.owner,
+                                                      self.level))
+                     if o == rank and l == level)
+
+    def slots_at(self, level: int) -> int:
+        """Padded per-rank slot count of one level's unit group."""
+        return max(len(self.rank_bricks_at(r, level))
+                   for r in range(self.n_ranks))
+
+    def start_table_at(self, level: int) -> np.ndarray:
+        """i32[n_ranks, slots_at(level)] global start rows of each
+        rank's level-``level`` brick slots, -1 for absent slots (the
+        per-level twin of `start_table`; identical to it on an
+        all-level-0 map)."""
+        bz = self.brick_depth
+        table = np.full((self.n_ranks, self.slots_at(level)), -1,
+                        np.int32)
+        for r in range(self.n_ranks):
+            for s, b in enumerate(self.rank_bricks_at(r, level)):
+                table[r, s] = b * bz
+        return table
+
+    @property
+    def total_slots(self) -> int:
+        """March units per rank across every level group (== ``slots``
+        on an all-level-0 map) — the slot count the row-stacked temporal
+        threshold state and the concatenated fragment stream carry."""
+        return sum(self.slots_at(l) for l in self.levels_present())
+
+    def with_levels(self, levels: Sequence[int]) -> "BrickMap":
+        """Same ownership, new per-brick refinement levels (validated
+        by construction)."""
+        return BrickMap(self.depth, self.n_ranks, self.owner,
+                        tuple(int(l) for l in levels))
+
     # ---------------------------------------------------------- structure
     def is_even_convex(self) -> bool:
         """Does this map reproduce the even contiguous z-slab split?
         True ⇒ the builders short-circuit to the pre-brick path
-        (bitwise identical to a brickless step)."""
+        (bitwise identical to a brickless step). Any coarse level keeps
+        the brick path alive — only an ALL-FINE even map is the slab."""
+        if any(self.level):
+            return False
         nb, n = self.nbricks, self.n_ranks
         if nb % n:
             return False
@@ -124,13 +202,14 @@ class BrickMap:
 
     def permute(self, perm: Sequence[int]) -> "BrickMap":
         """Relabel ranks: brick owned by r moves to ``perm[r]`` — the
-        composite-invariance test's ownership shuffle."""
+        composite-invariance test's ownership shuffle (levels ride
+        their bricks)."""
         perm = [int(p) for p in perm]
         if sorted(perm) != list(range(self.n_ranks)):
             raise ValueError(f"perm {perm} is not a permutation of "
                              f"0..{self.n_ranks - 1}")
         return BrickMap(self.depth, self.n_ranks,
-                        tuple(perm[o] for o in self.owner))
+                        tuple(perm[o] for o in self.owner), self.level)
 
     # -------------------------------------------------------- constructors
     @classmethod
@@ -216,7 +295,12 @@ def steal_plan(prev: BrickMap, work: np.ndarray, max_moves: int = 2,
     mean`` — the session keys recompiles on map identity, so a stable
     scene must converge to zero moves, not oscillate. The move cap
     bounds both the per-replan recompile delta and the reslab traffic a
-    single replan can add."""
+    single replan can add.
+
+    Refinement levels ride their bricks unchanged through every move;
+    pass ``work`` already scaled to LEVEL UNITS (a level-l brick costs
+    a fraction of its fine self — parallel/lod.level_work_scale) so the
+    equalizer balances what the ranks actually march."""
     work = np.asarray(work, np.float64)
     if work.shape != (prev.nbricks,):
         raise ValueError(f"work has {work.shape} entries for "
@@ -249,4 +333,5 @@ def steal_plan(prev: BrickMap, work: np.ndarray, max_moves: int = 2,
         moved += 1
     if not moved:
         return prev
-    return BrickMap(prev.depth, n, tuple(int(o) for o in owner))
+    return BrickMap(prev.depth, n, tuple(int(o) for o in owner),
+                    prev.level)

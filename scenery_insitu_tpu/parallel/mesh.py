@@ -225,3 +225,67 @@ def reslab_bricks(local: jnp.ndarray, bmap, axis_name: str = DEFAULT_AXIS,
     out = _reslab_rows(local, g_all.reshape(n, -1),
                        live_all.reshape(n, -1), axis_name)
     return out.reshape((bmap.slots, rows) + local.shape[1:])
+
+
+def reslab_bricks_lod(local: jnp.ndarray, bmap,
+                      axis_name: str = DEFAULT_AXIS, h: int = 1):
+    """Materialize this rank's MULTI-RESOLUTION brick set from the even
+    z-slab shards (docs/PERF.md "LOD marching"): the level-aware twin of
+    `reslab_bricks`. Returns ``{level: [slots_at(level), bz/f + 2h,
+    H/f, W/f]}`` for every level present in the map (f = 2^level) —
+    downsampling happens HERE, on device, after the ppermute routing of
+    the FINE rows, so HBM holds fine data only for level-0 bricks.
+
+    Per level, each slot gathers the fine global rows ``[start - h*f,
+    start + bz + h*f)`` (the halo deepens with the level so the pooled
+    copy still carries ``h`` COARSE halo rows, with exactly
+    `halo_exchange_z`'s boundary contract at the global edges) and
+    average-pools by ``f`` in all three dims — f32 accumulation, cast
+    back to the input dtype, so a bf16 render copy pools without
+    compounding rounding. A coarse voxel tiles ``f^3`` fine voxels
+    exactly: the pooled volume keeps the band's corner origin with
+    ``spacing * f`` (the corner-origin convention makes the pooled
+    centers land where trilinear expects them — no half-voxel shift).
+
+    The brick depth divides by ``f`` by BrickMap construction; the
+    in-plane extents must too — a clear error here, not a silent
+    mis-shape. Level 0 reproduces `reslab_bricks`' rows bit-for-bit
+    (same ladder, same routing, no pooling)."""
+    import numpy as np
+
+    from scenery_insitu_tpu.utils.compat import axis_size
+    n = axis_size(axis_name)
+    if bmap.n_ranks != n:
+        raise ValueError(f"brick map built for {bmap.n_ranks} ranks on a "
+                         f"{n}-rank mesh")
+    dn = local.shape[0]
+    d = dn * n
+    if bmap.depth != d:
+        raise ValueError(f"brick map covers depth {bmap.depth} but the "
+                         f"volume has {d} slices")
+    bz = bmap.brick_depth
+    hh, ww = local.shape[1], local.shape[2]
+    out = {}
+    for lvl in bmap.levels_present():
+        f = 1 << lvl
+        if hh % f or ww % f:
+            raise ValueError(
+                f"brick level {lvl} pools by {f} but the in-plane "
+                f"extents ({hh}, {ww}) do not divide — cap "
+                f"lod.max_level so 2^level tiles every axis")
+        rows_f = bz + 2 * h * f
+        table = bmap.start_table_at(lvl)                  # [n, B_l]
+        slots = table.shape[1]
+        ladder = np.arange(rows_f)[None, None, :] - h * f
+        g_all = np.clip(table[:, :, None] + ladder, 0, d - 1)
+        live_all = np.broadcast_to((table >= 0)[:, :, None], g_all.shape)
+        fine = _reslab_rows(local, g_all.reshape(n, -1),
+                            live_all.reshape(n, -1), axis_name)
+        fine = fine.reshape((slots, rows_f) + local.shape[1:])
+        if f == 1:
+            out[lvl] = fine
+            continue
+        x = fine.reshape(slots, rows_f // f, f, hh // f, f, ww // f, f)
+        x = jnp.mean(x.astype(jnp.float32), axis=(2, 4, 6))
+        out[lvl] = x.astype(local.dtype)
+    return out

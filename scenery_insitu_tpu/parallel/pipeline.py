@@ -97,7 +97,8 @@ from scenery_insitu_tpu.ops.composite import composite_plain, composite_vdis
 from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
 from scenery_insitu_tpu.parallel.mesh import (halo_exchange_z,
-                                              reslab_bricks, reslab_z)
+                                              reslab_bricks,
+                                              reslab_bricks_lod, reslab_z)
 from scenery_insitu_tpu.parallel.topology import resolve_mesh_topology
 
 from scenery_insitu_tpu.utils.compat import shard_map
@@ -627,7 +628,9 @@ def _bricks_build_marker(bmap, n: int) -> None:
     rec.count("bricks_steps_built")
     rec.event("bricks_build", ranks=n, nbricks=bmap.nbricks,
               brick_depth=bmap.brick_depth, slots=bmap.slots,
-              owner=list(bmap.owner), bricks_per_rank=counts)
+              owner=list(bmap.owner), bricks_per_rank=counts,
+              level=list(bmap.level), max_level=bmap.max_level,
+              total_slots=bmap.total_slots)
 
 
 def _resolve_bricks(comp_cfg, n: int, bricks):
@@ -659,7 +662,9 @@ def _resolve_bricks(comp_cfg, n: int, bricks):
     if bricks.n_ranks != n:
         raise ValueError(f"brick map built for {bricks.n_ranks} ranks on "
                          f"a {n}-rank mesh")
-    if n == 1 or bricks.is_even_convex():
+    # a single-rank mesh only short-circuits when every brick is level 0
+    # — a coarse level still changes WHAT is marched, not just where
+    if (n == 1 and bricks.max_level == 0) or bricks.is_even_convex():
         return None
     _bricks_build_marker(bricks, n)
     return bricks
@@ -686,15 +691,29 @@ def _brick_units(local_data, origin, spacing, spec, axis, n, bmap):
 
     Materializes the rank's brick set ONCE (`mesh.reslab_bricks`, halo
     rows from the TRUE global neighbors whichever rank owns them) and
-    returns ``([(vol, v_bounds, w_bounds)] * slots, gmax, dims)`` — one
-    unit per brick slot, each a `_rank_slab`-shaped (volume, ownership
-    bounds) pair the existing per-chunk march consumes unchanged:
-    z marches own their brick through the ``w_bounds`` world interval,
-    x/y marches through the ``v_bounds`` half-open interval (the brick
-    owning the global top keeps the even path's +dz edge slack). Absent
-    slots (rank owns fewer bricks than the busiest) carry zero rows and
-    an EMPTY interval — every sample masks dead, the occupancy pyramid
-    admits them as dead, and the fragment comes out all-+inf."""
+    returns ``([(vol, v_bounds, w_bounds, f)] * total_slots, gmax, dims,
+    ref)`` — one unit per brick slot, each a `_rank_slab`-shaped
+    (volume, ownership bounds) pair the existing per-chunk march
+    consumes unchanged: z marches own their brick through the
+    ``w_bounds`` world interval, x/y marches through the ``v_bounds``
+    half-open interval (the brick owning the global top keeps the even
+    path's +dz edge slack). Absent slots (rank owns fewer bricks than
+    the busiest) carry zero rows and an EMPTY interval — every sample
+    masks dead, the occupancy pyramid admits them as dead, and the
+    fragment comes out all-+inf.
+
+    LOD (docs/PERF.md "LOD marching"): when the map carries levels,
+    slots group BY LEVEL (`mesh.reslab_bricks_lod` — global per-level
+    slot counts keep SPMD shapes rank-uniform) and a level-l unit is the
+    2^l reshape-mean-pooled brick as a Volume with spacing*2^l at the
+    SAME corner origin, marched on the shared fine-pitch camera with
+    ``dwm*2^l`` / ``step_scale=2^-l`` so coarse slices accumulate the
+    opacity of the fine slices they replace. Ownership bounds stay the
+    FINE brick world interval — the composited fragment stream is
+    resolution-agnostic. ``f`` is the unit's downsample factor (1 for
+    level 0); ``ref`` is the fine-pitch reference Volume for the shared
+    camera/metadata (the all-level-0 path returns the existing units +
+    f=1 + ref=units[0] — BITWISE the pre-LOD build)."""
     if getattr(spec, "render_dtype", "f32") == "bf16" \
             and local_data.dtype == jnp.float32:
         local_data = local_data.astype(jnp.bfloat16)
@@ -705,34 +724,66 @@ def _brick_units(local_data, origin, spacing, spec, axis, n, bmap):
     dz = spacing[2]
     gmax = origin + jnp.array([w, h, d], jnp.float32) * spacing
     bz = bmap.brick_depth
-    table = jnp.asarray(bmap.start_table(), jnp.int32)     # [n, B]
     z_march = spec.axis == 2
-    bands = reslab_bricks(local_data, bmap, axis,
-                          h=0 if z_march else 1)
     units = []
-    for s in range(bmap.slots):
-        start = table[r, s]                                # -1 = absent
-        present = start >= 0
-        startf = start.astype(jnp.float32)
-        z_lo = origin[2] + startf * dz
-        z_hi = origin[2] + (startf + bz) * dz
-        if z_march:
-            vol = Volume(bands[s], origin.at[2].add(startf * dz), spacing)
-            # open-interval march ownership (slice centers sit half a
-            # voxel inside); an absent slot's interval is empty
-            wb = (jnp.where(present, z_lo, jnp.inf),
-                  jnp.where(present, z_hi, -jnp.inf))
-            units.append((vol, None, wb))
-        else:
-            vol = Volume(bands[s], origin.at[2].add((startf - 1.0) * dz),
-                         spacing)
-            # the brick covering the global top keeps the even path's
-            # +dz slack (its clamped halo row may re-admit pos == max)
-            hi = jnp.where(start + bz == d, z_hi + dz, z_hi)
-            vb = (jnp.where(present, z_lo, jnp.inf),
-                  jnp.where(present, hi, -jnp.inf))
-            units.append((vol, vb, None))
-    return units, gmax, (w, h, d)
+    if bmap.max_level == 0:
+        table = jnp.asarray(bmap.start_table(), jnp.int32)  # [n, B]
+        bands = reslab_bricks(local_data, bmap, axis,
+                              h=0 if z_march else 1)
+        for s in range(bmap.slots):
+            start = table[r, s]                            # -1 = absent
+            present = start >= 0
+            startf = start.astype(jnp.float32)
+            z_lo = origin[2] + startf * dz
+            z_hi = origin[2] + (startf + bz) * dz
+            if z_march:
+                vol = Volume(bands[s], origin.at[2].add(startf * dz),
+                             spacing)
+                # open-interval march ownership (slice centers sit half
+                # a voxel inside); an absent slot's interval is empty
+                wb = (jnp.where(present, z_lo, jnp.inf),
+                      jnp.where(present, z_hi, -jnp.inf))
+                units.append((vol, None, wb, 1))
+            else:
+                vol = Volume(bands[s],
+                             origin.at[2].add((startf - 1.0) * dz),
+                             spacing)
+                # the brick covering the global top keeps the even
+                # path's +dz slack (its clamped halo row may re-admit
+                # pos == max)
+                hi = jnp.where(start + bz == d, z_hi + dz, z_hi)
+                vb = (jnp.where(present, z_lo, jnp.inf),
+                      jnp.where(present, hi, -jnp.inf))
+                units.append((vol, vb, None, 1))
+        return units, gmax, (w, h, d), units[0][0]
+    halo = 0 if z_march else 1
+    bands = reslab_bricks_lod(local_data, bmap, axis, h=halo)
+    for lvl in bmap.levels_present():
+        f = 1 << lvl
+        arr = bands[lvl]
+        table_l = jnp.asarray(bmap.start_table_at(lvl), jnp.int32)
+        for s in range(table_l.shape[1]):
+            start = table_l[r, s]
+            present = start >= 0
+            startf = start.astype(jnp.float32)
+            z_lo = origin[2] + startf * dz
+            z_hi = origin[2] + (startf + bz) * dz
+            org = origin.at[2].add((startf - halo * float(f)) * dz)
+            vol = Volume(arr[s], org, spacing * float(f))
+            if z_march:
+                wb = (jnp.where(present, z_lo, jnp.inf),
+                      jnp.where(present, z_hi, -jnp.inf))
+                units.append((vol, None, wb, f))
+            else:
+                # coarse top-edge slack scales with the pooled pitch
+                # (the clamped halo row spans f fine rows)
+                hi = jnp.where(start + bz == d, z_hi + float(f) * dz,
+                               z_hi)
+                vb = (jnp.where(present, z_lo, jnp.inf),
+                      jnp.where(present, hi, -jnp.inf))
+                units.append((vol, vb, None, f))
+    ref = Volume(jnp.zeros((1, 1, 1), local_data.dtype), origin, spacing)
+    return units, gmax, (w, h, d), ref
 
 
 def _brick_clip_units(local_data, origin, spacing, d_global, axis, bmap):
@@ -740,7 +791,17 @@ def _brick_clip_units(local_data, origin, spacing, d_global, axis, bmap):
     (volume, clip AABB) per brick slot. The clip AABBs tile the global
     volume exactly like the slab AABBs do (absent slots get an empty
     box), and the sample ladder stays the GLOBAL box — which is what
-    makes the composited frame bitwise invariant to ownership."""
+    makes the composited frame bitwise invariant to ownership.
+
+    The gather engine has no coarse march (its t ladder is global and
+    level-free): a level-carrying map renders every brick at level 0
+    here, declared on the `lod.engine` ledger — not silently."""
+    if bmap.max_level:
+        from scenery_insitu_tpu import obs as _obs
+
+        _obs.degrade("lod.engine", "lod", "fine",
+                     "the gather engine has no LOD march (MXU builders "
+                     "only); every brick samples at level 0", warn=False)
     r = jax.lax.axis_index(axis)
     h, w = local_data.shape[1], local_data.shape[2]
     dz = spacing[2]
@@ -795,27 +856,31 @@ def _mxu_rank_generate_bricks(local_data, origin, spacing, cam, slicer,
     argument (tests/test_bricks.py). Temporal mode carries one
     [nj, ni] threshold map set PER SLOT, row-stacked.
 
-    Returns (vdi [slots*K], meta, axcam, thr')."""
-    units, gmax, dims = _brick_units(local_data, origin, spacing, spec,
-                                     axis, n, bmap)
-    axcam = slicer.make_axis_camera(units[0][0], cam, spec,
+    Returns (vdi [total_slots*K], meta, axcam, thr'). Coarse slots (LOD
+    maps, docs/PERF.md "LOD marching") march on the shared fine-pitch
+    camera with per-unit ``dwm*f`` / ``step_scale=1/f`` — the f==1 path
+    is bitwise the pre-LOD build (``axc is axcam``, default scale)."""
+    units, gmax, dims, ref = _brick_units(local_data, origin, spacing,
+                                          spec, axis, n, bmap)
+    axcam = slicer.make_axis_camera(ref, cam, spec,
                                     box_min=origin, box_max=gmax)
     nj = spec.nj
     colors, depths, thr2s = [], [], []
-    for s, (vol, vb, wb) in enumerate(units):
+    for s, (vol, vb, wb, f) in enumerate(units):
+        axc = axcam if f == 1 else axcam._replace(dwm=axcam.dwm * f)
         if threshold is None:
             vdi, _, _ = slicer.generate_vdi_mxu(
                 vol, tf, cam, spec, vdi_cfg, v_bounds=vb, w_bounds=wb,
-                axcam=axcam)
+                axcam=axc, step_scale=1.0 / f)
         else:
             vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
                 vol, tf, cam, spec, _thr_slot(threshold, s, nj), vdi_cfg,
-                v_bounds=vb, w_bounds=wb, axcam=axcam)
+                v_bounds=vb, w_bounds=wb, axcam=axc, step_scale=1.0 / f)
             thr2s.append(t2)
         colors.append(vdi.color)
         depths.append(vdi.depth)
     thr2 = _stack_thr(thr2s) if thr2s else None
-    meta = slicer._vdi_meta(units[0][0], axcam, spec.ni, spec.nj, 0)
+    meta = slicer._vdi_meta(ref, axcam, spec.ni, spec.nj, 0)
     meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
     return (VDI(jnp.concatenate(colors, axis=0),
                 jnp.concatenate(depths, axis=0)), meta, axcam, thr2)
@@ -835,17 +900,17 @@ def _mxu_rank_generate_bricks_waves(local_data, origin, spacing, cam,
 
     from scenery_insitu_tpu.ops import occupancy as _occ
 
-    units, gmax, dims = _brick_units(local_data, origin, spacing, spec,
-                                     axis, n, bmap)
+    units, gmax, dims, ref = _brick_units(local_data, origin, spacing,
+                                          spec, axis, n, bmap)
     t = comp_cfg.wave_tiles
     slicer.wave_block(spec.ni, n, t)
-    axcam = slicer.make_axis_camera(units[0][0], cam, spec,
+    axcam = slicer.make_axis_camera(ref, cam, spec,
                                     box_min=origin, box_max=gmax)
-    volps = [slicer.permute_volume(vol, spec) for vol, _, _ in units]
+    volps = [slicer.permute_volume(vol, spec) for vol, _, _, _ in units]
     pyrs = [(_occ.pyramid_from_volume(vol, tf, spec, volp=vp)
              if spec.skip_empty else None)
-            for (vol, _, _), vp in zip(units, volps)]
-    _wave_build_marker(n, t, bmap.slots * vdi_cfg.max_supersegments,
+            for (vol, _, _, _), vp in zip(units, volps)]
+    _wave_build_marker(n, t, len(units) * vdi_cfg.max_supersegments,
                        spec.nj, spec.ni,
                        comp_cfg.max_output_supersegments,
                        comp_cfg.exchange, comp_cfg.ring_slots,
@@ -855,20 +920,22 @@ def _mxu_rank_generate_bricks_waves(local_data, origin, spacing, cam,
     def march_wave(w, thr_full):
         axcam_w, spec_w = slicer.wave_camera(axcam, spec, n, t, w)
         cs, ds, t2s = [], [], []
-        for s, (vol, vb, wb) in enumerate(units):
+        for s, (vol, vb, wb, f) in enumerate(units):
+            axc = (axcam_w if f == 1
+                   else axcam_w._replace(dwm=axcam_w.dwm * f))
             thr_s = (None if thr_full is None else
                      jtu.tree_map(lambda m: slicer.wave_cols(m, n, t, w),
                                   _thr_slot(thr_full, s, nj)))
             if thr_s is None:
                 vdi, _, _ = slicer.generate_vdi_mxu(
                     vol, tf, cam, spec_w, vdi_cfg, v_bounds=vb,
-                    w_bounds=wb, occupancy=pyrs[s], axcam=axcam_w,
-                    volp=volps[s])
+                    w_bounds=wb, occupancy=pyrs[s], axcam=axc,
+                    volp=volps[s], step_scale=1.0 / f)
             else:
                 vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
                     vol, tf, cam, spec_w, thr_s, vdi_cfg, v_bounds=vb,
-                    w_bounds=wb, occupancy=pyrs[s], axcam=axcam_w,
-                    volp=volps[s])
+                    w_bounds=wb, occupancy=pyrs[s], axcam=axc,
+                    volp=volps[s], step_scale=1.0 / f)
                 t2s.append(t2)
             cs.append(vdi.color)
             ds.append(vdi.depth)
@@ -888,7 +955,7 @@ def _mxu_rank_generate_bricks_waves(local_data, origin, spacing, cam,
 
     (oc, od), thr2 = _wave_pipeline(t, march_wave, compose, threshold)
     vdi = VDI(_wave_assemble(oc), _wave_assemble(od))
-    meta = slicer._vdi_meta(units[0][0], axcam, spec.ni, spec.nj, 0)
+    meta = slicer._vdi_meta(ref, axcam, spec.ni, spec.nj, 0)
     meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
     return vdi, meta, axcam, thr2
 
@@ -1670,13 +1737,20 @@ def distributed_initial_threshold_mxu(mesh: Mesh, tf: TransferFunction,
 
     def seed(local_data, origin, spacing, cam: Camera):
         if bricks is not None:
-            units, gmax, _ = _brick_units(local_data, origin, spacing,
-                                          spec, axis, n, bricks)
+            units, gmax, _, ref = _brick_units(local_data, origin,
+                                               spacing, spec, axis, n,
+                                               bricks)
+            axcam = slicer.make_axis_camera(ref, cam, spec,
+                                            box_min=origin, box_max=gmax)
             return _stack_thr([
-                slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg,
-                                         box_min=origin, box_max=gmax,
-                                         v_bounds=vb, w_bounds=wb)
-                for vol, vb, wb in units])
+                slicer.initial_threshold(
+                    vol, tf, cam, spec, vdi_cfg,
+                    box_min=origin, box_max=gmax,
+                    v_bounds=vb, w_bounds=wb,
+                    axcam=(axcam if f == 1
+                           else axcam._replace(dwm=axcam.dwm * f)),
+                    step_scale=1.0 / f)
+                for vol, vb, wb, f in units])
         vol, gmax, v_bounds, w_bounds, _ = _rank_slab(
             local_data, origin, spacing, spec, axis, n, plan=plan)
         return slicer.initial_threshold(vol, tf, cam, spec, vdi_cfg,

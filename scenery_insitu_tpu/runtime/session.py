@@ -401,6 +401,7 @@ class InSituSession:
         self._mxu_reuse = {}   # regime key -> temporal-reuse ReuseState
         self._scan_steps = {}  # (kind, regime, block) -> scan executable
         self._profile_fn = None  # jitted z-live-profile fetch (replan)
+        self._ranges_fn = None   # jitted z-range fetch (LOD TF gate)
         self._tf_key = _tf_fingerprint(self.tf)
         self.mode = "vdi"
         if isinstance(self.sim, ParticleSimAdapter):
@@ -492,9 +493,13 @@ class InSituSession:
 
     def _decomp_key(self):
         """The render-decomposition half of the step-cache key — cached
-        steps bake the plan / brick map in as build-time geometry."""
+        steps bake the plan / brick map in as build-time geometry (for
+        LOD maps that includes the LEVEL tuple: a level change
+        materializes different pooled volumes, so steps compiled for
+        one level assignment must never serve another)."""
         return (self._plan,
-                None if self._bricks is None else self._bricks.owner)
+                None if self._bricks is None
+                else (self._bricks.owner, self._bricks.level))
 
     def _tf_invalidate(self) -> None:
         """Steered-TF recompile-or-reuse keyed on TF identity
@@ -505,9 +510,17 @@ class InSituSession:
         schedule cycling through k looks pays k compiles total, not one
         per update. Carried temporal threshold / reuse state re-seeds
         either way (it tracks scene content under the OLD TF)."""
+        if self.cfg.lod.enabled:
+            # the TF-straddle coarsening gate is TF-dependent: force the
+            # level replan to re-run before the next march so a brick
+            # whose range straddles a NEW opacity edge refines on the
+            # very next frame, never a stale one (render_frame replans
+            # before it dispatches; tests/test_lod.py property test)
+            self._plan_frame = None
         old_key = (self._tf_key,) + self._decomp_key()
         self._step_cache[old_key] = (self._mxu_steps, self._scan_steps,
-                                     self._step, self._profile_fn)
+                                     self._step, self._profile_fn,
+                                     self._ranges_fn)
         while len(self._step_cache) > 8:        # bound compiled-step pins
             self._step_cache.pop(next(iter(self._step_cache)))
         new_fp = _tf_fingerprint(self.tf)
@@ -515,7 +528,7 @@ class InSituSession:
         entry = self._step_cache.get((new_fp,) + self._decomp_key())
         if entry is not None:
             (self._mxu_steps, self._scan_steps, self._step,
-             self._profile_fn) = entry
+             self._profile_fn, self._ranges_fn) = entry
             self._mxu_thr = {}
             self._mxu_reuse = {}
             self._tf_key = new_fp
@@ -717,6 +730,33 @@ class InSituSession:
         field = shard_volume(self.sim.field, self.mesh)
         return np.asarray(self._profile_fn(field))
 
+    def _replan_ranges(self):
+        """Fetch the GLOBAL per-z-bin sampled value range of the current
+        field (host numpy) — `ops/occupancy.z_range_profile` on each
+        rank's even slab, concatenated along the mesh axis. The LOD
+        planner's TF-straddle gate input (docs/PERF.md "LOD marching");
+        cached like `_replan_profile`."""
+        from jax.sharding import PartitionSpec as P
+
+        from scenery_insitu_tpu.ops import occupancy as _occ
+        from scenery_insitu_tpu.utils.compat import shard_map
+
+        if self._ranges_fn is None:
+            axis = self._flat_axis
+            n = self._n_ranks
+            dn = int(self.sim.field.shape[0]) // n
+            nzb = _occ._cap_divisor(dn, 32)
+
+            def rng(local):
+                return _occ.z_range_profile(local, nzb=nzb)
+
+            self._ranges_fn = jax.jit(shard_map(
+                rng, mesh=self.mesh, in_specs=P(axis, None, None),
+                out_specs=(P(axis), P(axis)), check_vma=False))
+        field = shard_volume(self.sim.field, self.mesh)
+        lo, hi = self._ranges_fn(field)
+        return np.asarray(lo), np.asarray(hi)
+
     def _maybe_replan(self) -> None:
         """Host-side re-plan of the RENDER z decomposition
         (CompositeConfig.rebalance == "occupancy"; docs/PERF.md "Render
@@ -729,11 +769,24 @@ class InSituSession:
         event carrying the slice histogram and modeled straggler
         factors."""
         cc = self.cfg.composite
+        if self.cfg.lod.enabled and cc.rebalance != "bricks":
+            # LOD levels live on the brick map — without the brick
+            # partition there is nothing to carry them (configured-but-
+            # inert knob: say so once, don't silently render level 0)
+            _obs.degrade(
+                "lod.inert", "lod", "off",
+                f"lod.enabled needs composite.rebalance='bricks' to "
+                f"carry levels (got {cc.rebalance!r}); every march "
+                "samples at level 0", warn=False)
         if cc.rebalance not in ("occupancy", "bricks"):
             return
         n = self._n_ranks
+        # an LOD session replans on a single rank too: a level change
+        # alters WHAT that rank marches, not just who marches what
+        lod_on = (self.cfg.lod.enabled and cc.rebalance == "bricks"
+                  and self.mode == "vdi" and hasattr(self.sim, "field"))
         if self.mode == "particles" or not hasattr(self.sim, "field") \
-                or n == 1:
+                or (n == 1 and not lod_on):
             # configured-but-inert knob: say so once instead of silently
             # rendering even splits forever
             _obs.degrade(
@@ -794,9 +847,20 @@ class InSituSession:
         rank (parallel.bricks.steal_plan, hysteresis-stable). An adopted
         map change drops the compiled steps exactly like a slab replan;
         a map that converges back to the even-convex assignment restores
-        the brickless fast path."""
+        the brickless fast path.
+
+        With ``lod.enabled`` the replan ALSO selects per-brick
+        refinement levels (`parallel.lod.select_levels`: screen-space
+        error + empty coarsening + hysteresis + the TF-straddle gate)
+        and scales the stolen work into level units
+        (`parallel.lod.level_work_scale`) — a level-2 brick is ~64x
+        cheaper than its level-0 self, and equalizing raw live work
+        would re-create the straggler the levels just removed. A level
+        change recompiles exactly like an ownership change (the
+        `_decomp_key` carries the level tuple)."""
         from scenery_insitu_tpu.parallel import bricks as _bk
 
+        lod = self.cfg.lod
         d = int(self.sim.field.shape[0])
         with self.obs.span("replan", frame=self.frame_index):
             profile = self._replan_profile()
@@ -805,6 +869,30 @@ class InSituSession:
             seed = _bk.BrickMap.contiguous(d, n, nb)
             prev = (self._bricks if self._bricks is not None
                     and self._bricks.nbricks == nb else seed)
+            if lod.enabled:
+                from scenery_insitu_tpu.core.transfer import opacity_edges
+                from scenery_insitu_tpu.parallel import lod as _lod
+
+                lo, hi = self._replan_ranges()
+                shp = self.sim.field.shape                  # (D, H, W)
+                dims = (int(shp[2]), int(shp[1]), int(shp[0]))
+                cam = self.camera
+                levels = _lod.select_levels(
+                    _lod.per_brick(profile, nb, red="mean"),
+                    _lod.per_brick(lo, nb, red="min"),
+                    _lod.per_brick(hi, nb, red="max"),
+                    opacity_edges(self.tf, lod.tf_edge_eps),
+                    dims=dims, origin=np.asarray(self._origin),
+                    spacing=np.asarray(self._spacing),
+                    eye=np.asarray(cam.eye), fov_y=float(cam.fov_y),
+                    height_px=self.cfg.render.height, cfg=lod,
+                    prev=(self._bricks.level
+                          if self._bricks is not None
+                          and self._bricks.nbricks == nb else None))
+                prev = prev.with_levels(levels)
+                work = work * _lod.level_work_scale(
+                    levels, dims, self.cfg.render.width,
+                    self.cfg.render.height)
             bm = _bk.steal_plan(prev, work,
                                 max_moves=cc.rebalance_max_moves,
                                 hysteresis=cc.rebalance_hysteresis)
@@ -812,12 +900,15 @@ class InSituSession:
         new = None if bm.is_even_convex() else bm
         cur = self._bricks
         if (new is None) == (cur is None) and \
-                (new is None or new.owner == cur.owner):
+                (new is None or (new.owner == cur.owner
+                                 and new.level == cur.level)):
             return                      # stable — nothing recompiles
         self.obs.count("rebalance_replans")
+        levels_now = list(bm.level)
         self.obs.event(
             "rebalance_plan", frame=self.frame_index, kind="bricks",
-            nbricks=nb, owner=list(bm.owner),
+            nbricks=nb, owner=list(bm.owner), level=levels_now,
+            max_level=int(max(levels_now)) if levels_now else 0,
             straggler_even=round(_bk.straggler_factor(seed, work), 3),
             straggler_planned=round(_bk.straggler_factor(bm, work), 3))
         _obs.degrade("occupancy.replan",

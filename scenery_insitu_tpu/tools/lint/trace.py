@@ -52,7 +52,7 @@ _BODY_TAKERS = {"scan": 0, "cond": None, "while_loop": None,
 
 # parameters that are static configuration by project convention
 _STATIC_NAME_RE = re.compile(
-    r"(^|_)(cfg|config|spec|specs|mesh|tf|axis|axis_name|slicer|engine|"
+    r"(^|_)(cfg|config|spec|specs|mesh|bmap|tf|axis|axis_name|slicer|engine|"
     r"mode|kind|wire|exchange|schedule|fold|background|colormap|"
     r"interpret|temporal|dtype|name|log|rec|recorder|key|sim)$"
     r"|^(self|n|t|k|w|h|d)$")

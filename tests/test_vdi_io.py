@@ -36,9 +36,21 @@ def test_codec_roundtrip(codec):
         if not lz4.available():
             pytest.skip("no C++ toolchain for the native lz4 codec")
     if codec == "zstd":
-        from scenery_insitu_tpu.io.vdi_io import have_zstd
+        from scenery_insitu_tpu.io.vdi_io import have_zstd, resolve_codec
         if not have_zstd():
-            pytest.skip("optional zstandard package not installed")
+            # optional dep absent: the writer entry points degrade the
+            # codec to stdlib zlib with a ledger entry, so the
+            # round-trip must still hold — assert THAT path instead of
+            # skipping (raw zstd compress stays strict by design).
+            import warnings
+
+            from scenery_insitu_tpu import obs
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                codec = resolve_codec("zstd")
+            assert codec == "zlib"
+            assert any(e["component"] == "io.vdi_codec"
+                       and e["to"] == "zlib" for e in obs.ledger())
     data = np.random.default_rng(0).standard_normal(1000).astype(np.float32)
     blob = compress(data.tobytes(), codec)
     assert decompress(blob, codec) == data.tobytes()

@@ -42,6 +42,11 @@ def main():
                     default="f32")
     ap.add_argument("--sim-fused", type=int, default=0)
     ap.add_argument("--scan-frames", type=int, default=0)
+    # fleet-telemetry overhead guard (ISSUE 17): A/B the per-frame cost
+    # of the obs plane (span + lineage + SLO observe) and fail if the
+    # enabled path costs more than --obs-budget over the disabled one
+    ap.add_argument("--obs-guard", action="store_true")
+    ap.add_argument("--obs-budget", type=float, default=0.02)
     args = ap.parse_args()
     n = args.ranks
 
@@ -112,8 +117,9 @@ def main():
         gs.GrayScott(u, v, gs.GrayScottParams.create()), args.sim_steps))
 
     def gen(local, o, s, c):
-        vdi, meta, _, _ = _mxu_rank_generate(local, o, s, c, slicer, spec,
-                                             tf, vdi_cfg, axis, n)
+        # (vdi, meta, axcam, thr', reuse') since the temporal-delta PR
+        vdi, meta, *_ = _mxu_rank_generate(local, o, s, c, slicer, spec,
+                                           tf, vdi_cfg, axis, n)
         return vdi.color, vdi.depth
 
     gen_fn = jax.jit(shard_map(
@@ -207,6 +213,35 @@ def main():
         scan_ms = round((time.perf_counter() - t0)
                         / args.scan_frames * 1000, 2)
 
+    # obs plane A/B: the identical warm fused frame, once under a
+    # disabled Recorder and once under an enabled one doing everything
+    # Session.run does per frame (span + lineage instant + SLO observe).
+    # The fleet-obs CI lane gates overhead_frac at --obs-budget (2%).
+    from scenery_insitu_tpu.config import SLOConfig
+    from scenery_insitu_tpu.obs.collector import lineage
+    from scenery_insitu_tpu.obs.slo import SLOEngine
+
+    obs_ab = {}
+    saved_rec = obs.get_recorder()
+    for mode in (False, True):
+        rec = obs.Recorder(enabled=mode)
+        obs.set_recorder(rec)
+        slo = SLOEngine(SLOConfig(enabled=mode, frame_p99_ms=1e9), rec)
+        t0 = time.perf_counter()
+        for it in range(args.iters):
+            t_f = time.perf_counter()
+            with rec.span("frame", frame=it):
+                out = fused(v, origin, spacing, cam)
+                jax.block_until_ready(out[0].color)
+            lineage("publish", "send", it)
+            slo.observe("frame_ms", (time.perf_counter() - t_f) * 1e3,
+                        frame=it)
+        obs_ab["enabled_ms" if mode else "disabled_ms"] = round(
+            (time.perf_counter() - t0) / args.iters * 1000, 2)
+    obs.set_recorder(saved_rec)
+    obs_ab["overhead_frac"] = round(
+        obs_ab["enabled_ms"] / max(obs_ab["disabled_ms"], 1e-9) - 1.0, 4)
+
     # the fused step covers generate+all_to_all+composite ONLY (sim runs
     # before it, gather after) — compare like with like
     split_render = sum(ms[k] for k in ("generate", "all_to_all", "composite"))
@@ -223,6 +258,7 @@ def main():
                    "sim_fused": sim_fused,    # EFFECTIVE (multi-rank
                    "scan_frames": args.scan_frames,  # downgrades to roll)
                    "scanloop_ms_per_frame": scan_ms},
+        "obs_overhead": obs_ab,
         # device-cost truth + everything that did not run as configured
         # (same record shape bench.py embeds — see docs/OBSERVABILITY.md)
         "cost_analysis": {"fused_step": cost_snapshot(
@@ -230,6 +266,11 @@ def main():
         "degradations": obs.ledger(),
         "backend": jax.default_backend(),
     }))
+
+    if args.obs_guard and obs_ab["overhead_frac"] > args.obs_budget:
+        print(f"[phase_bench] obs overhead {obs_ab['overhead_frac']:.2%} "
+              f"exceeds budget {args.obs_budget:.0%}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

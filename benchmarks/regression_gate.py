@@ -1,0 +1,358 @@
+"""Bench regression sentinel: schema-aware gates over the committed
+measurement artifacts (docs/OBSERVABILITY.md, "Regression sentinel").
+
+The committed artifacts in benchmarks/results/ are the repo's memory of
+what the system could do — but nothing re-reads them, so a PR that
+quietly halves the delta-encoding win or sinks weak-scaling efficiency
+ships green. This module closes that loop:
+
+- **self-check** (default): extract the gated keys from every committed
+  artifact of a known family and assert each invariant floor still
+  holds (the LOD ladder still clears x2 march-FLOP reduction at the
+  PSNR floor, weak scaling stays above 0.7, scenario parity stays
+  bitwise, ...). This is what CI runs — it fails if someone commits a
+  regressed artifact.
+- **check** (``--fresh FILE``): compare a freshly produced artifact
+  against the committed baseline of the same family, key by key, each
+  key with its own direction and noise band — timing-derived keys get
+  wide bands (CPU CI jitter is real), modeled/deterministic keys get
+  tight ones. Exit 1 on any move beyond the band in the worse
+  direction, or any floor violation.
+- **--record**: append one row per checked artifact to
+  ``benchmarks/results/trajectory.jsonl`` so the history of every gated
+  number is a ledger, not diff archaeology.
+
+Unknown-schema artifacts are skipped and ledgered
+(``regression.artifact``); a missing committed baseline in check mode
+degrades that artifact's gate to record-only (``regression.baseline``)
+instead of failing the world on a new benchmark's first landing.
+
+No JAX import — safe to run anywhere, any time (CI's fleet-obs lane).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scenery_insitu_tpu import obs  # noqa: E402
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "results")
+TRAJECTORY = os.path.join(RESULTS, "trajectory.jsonl")
+
+_UNKNOWN_REASON = ("artifact schema not recognized by any gate family; "
+                   "it is summarized but not regression-gated — add an "
+                   "extractor to benchmarks/regression_gate.py")
+_NOBASE_REASON = ("no committed baseline artifact for this family; the "
+                  "gate degrades to record-only for the first landing — "
+                  "commit the fresh artifact to arm it")
+_BADJSON_REASON = ("artifact is not parseable JSON; it cannot be gated "
+                   "and is skipped — regenerate or remove it")
+
+# band semantics: fractional tolerance on the baseline value before a
+# worse-direction move counts as a regression. Two tiers only, so the
+# table stays auditable: modeled/deterministic numbers vs wall-clock.
+DET = 0.01      # modeled, counted, or bitwise-derived quantities
+NOISY = 0.35    # wall-clock-derived quantities on shared CPU runners
+
+
+class Gate:
+    """One gated number: direction, noise band, optional hard floor
+    (worst absolute value acceptable regardless of the baseline)."""
+
+    __slots__ = ("value", "better", "band", "floor")
+
+    def __init__(self, value, better="higher", band=NOISY, floor=None):
+        self.value = float(value)
+        self.better = better
+        self.band = band
+        self.floor = floor
+
+    def violates_floor(self) -> bool:
+        if self.floor is None:
+            return False
+        if self.better == "higher":
+            return self.value < self.floor
+        return self.value > self.floor
+
+    def regressed_vs(self, base: "Gate") -> bool:
+        tol = abs(base.value) * self.band
+        if self.better == "higher":
+            return self.value < base.value - tol
+        return self.value > base.value + tol
+
+
+# ---------------------------------------------------------------- families
+
+def _x_lod(doc: dict) -> Dict[str, Gate]:
+    floor = float(doc.get("psnr_floor_db") or 40.0)
+    return {
+        "flop_reduction_at_floor": Gate(doc["value"], "higher", NOISY,
+                                        floor=2.0),
+        "psnr_db": Gate(doc["psnr_db"], "higher", DET, floor=floor),
+    }
+
+
+def _x_serve(doc: dict) -> Dict[str, Gate]:
+    out = {"per_viewer_cost_ratio_n16": Gate(doc["value"], "lower", NOISY,
+                                             floor=1.0)}
+    bpv = doc.get("bytes_per_viewer") or {}
+    if "wire" in bpv and "exact" in bpv:
+        # the q-packed wire must stay strictly cheaper than raw slabs
+        out["wire_bytes_ratio"] = Gate(bpv["wire"] / max(1, bpv["exact"]),
+                                       "lower", DET, floor=1.0)
+    return out
+
+
+def _x_delta(doc: dict) -> Dict[str, Gate]:
+    out = {}
+    for scene, sc in sorted((doc.get("scenes") or {}).items()):
+        wire, march = sc.get("wire") or {}, sc.get("march") or {}
+        if "bytes_ratio" in wire:
+            # fast scenes can honestly land a hair over 1.0 (delta can't
+            # win on an incompressible scene) — floor at pathology, gate
+            # the rest via the baseline band
+            out[f"{scene}.wire_bytes_ratio"] = Gate(
+                wire["bytes_ratio"], "lower", DET, floor=1.05)
+        if "skip_frac" in march:
+            out[f"{scene}.march_skip_frac"] = Gate(
+                march["skip_frac"], "higher", DET)
+        if "max_abs_err_vs_off" in march:
+            out[f"{scene}.march_max_abs_err"] = Gate(
+                march["max_abs_err_vs_off"], "lower", DET, floor=1e-5)
+    return out
+
+
+def _x_rebalance(doc: dict) -> Dict[str, Gate]:
+    out = {"straggler_reduction": Gate(doc["value"], "higher", NOISY,
+                                       floor=1.0)}
+    if "value_bricks" in doc:
+        out["straggler_reduction_bricks"] = Gate(
+            doc["value_bricks"], "higher", NOISY, floor=1.0)
+    mod = doc.get("modeled") or {}
+    if "straggler_planned" in mod and "straggler_even" in mod:
+        out["modeled_planned_over_even"] = Gate(
+            mod["straggler_planned"] / mod["straggler_even"],
+            "lower", DET, floor=1.0)
+    return out
+
+
+def _x_hier(doc: dict) -> Dict[str, Gate]:
+    return {"weak_efficiency": Gate(doc["value"], "higher", NOISY,
+                                    floor=0.7)}
+
+
+def _x_scenario(doc: dict) -> Dict[str, Gate]:
+    return {
+        "scenarios_registered": Gate(doc["value"], "higher", 0.0,
+                                     floor=4),
+        "parity_ok": Gate(1.0 if doc.get("parity_ok") else 0.0,
+                          "higher", 0.0, floor=1.0),
+    }
+
+
+def _x_waves(doc: dict) -> Dict[str, Gate]:
+    out = {}
+    for key, e in sorted((doc.get("exchange") or {}).items()):
+        mod = e.get("modeled") or {}
+        if "overlap_hidden_frac" in mod:
+            out[f"{key}.overlap_hidden_frac"] = Gate(
+                mod["overlap_hidden_frac"], "higher", DET, floor=0.5)
+    par = doc.get("schedule_parity") or {}
+    if "max_abs_diff_color" in par:
+        out["schedule_parity_max_abs_diff"] = Gate(
+            par["max_abs_diff_color"], "lower", DET, floor=1e-5)
+    return out
+
+
+# (family name, matcher over the parsed doc, extractor)
+FAMILIES: Tuple[Tuple[str, object, object], ...] = (
+    ("lod_ladder",
+     lambda d: str(d.get("metric", "")).startswith("lod_ladder"), _x_lod),
+    ("serve_bench",
+     lambda d: d.get("metric") == "serve_bench", _x_serve),
+    ("delta_ab",
+     lambda d: d.get("kind") == "delta_ab", _x_delta),
+    ("rebalance_ab",
+     lambda d: str(d.get("metric", "")).startswith("rebalance_ab"),
+     _x_rebalance),
+    ("hier_weak_scaling",
+     lambda d: str(d.get("metric", "")).startswith("hier_weak_scaling"),
+     _x_hier),
+    ("scenario_bench",
+     lambda d: str(d.get("metric", "")).startswith("scenario_bench"),
+     _x_scenario),
+    ("composite_ab",
+     lambda d: isinstance(d.get("exchange"), dict), _x_waves),
+)
+
+
+def load_artifact(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        obs.degrade("regression.artifact", os.path.basename(path),
+                    "skipped", _BADJSON_REASON, warn=False)
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def classify(doc: dict) -> Optional[Tuple[str, Dict[str, Gate]]]:
+    """(family, gates) for a known artifact schema, else None (ledgered
+    by the caller that wanted it gated)."""
+    for name, match, extract in FAMILIES:
+        if match(doc):
+            try:
+                return name, extract(doc)
+            except (KeyError, TypeError, ZeroDivisionError):
+                obs.degrade("regression.artifact", name, "skipped",
+                            _UNKNOWN_REASON, warn=False)
+                return None
+    return None
+
+
+def committed_baseline(family: str,
+                       results_dir: str = RESULTS
+                       ) -> Optional[Tuple[str, Dict[str, Gate]]]:
+    """Newest committed artifact of the family (lexicographically last
+    wins — the rN naming convention sorts by PR)."""
+    best = None
+    for path in sorted(glob.glob(os.path.join(results_dir, "*.json"))):
+        doc = load_artifact(path)
+        if doc is None:
+            continue
+        got = classify(doc)
+        if got and got[0] == family:
+            best = (os.path.basename(path), got[1])
+    return best
+
+
+# ------------------------------------------------------------------ checks
+
+def check_floors(name: str, gates: Dict[str, Gate]) -> List[str]:
+    return [f"{name}: {k} = {g.value:g} violates floor {g.floor:g} "
+            f"({g.better} is better)"
+            for k, g in sorted(gates.items()) if g.violates_floor()]
+
+
+def check_fresh(fresh_path: str, baseline_path: Optional[str] = None,
+                results_dir: str = RESULTS) -> Tuple[List[str], dict]:
+    """(failures, report) for a fresh artifact vs its family baseline."""
+    doc = load_artifact(fresh_path)
+    if doc is None:
+        return [f"{fresh_path}: unreadable artifact"], {}
+    got = classify(doc)
+    if got is None:
+        obs.degrade("regression.artifact", os.path.basename(fresh_path),
+                    "skipped", _UNKNOWN_REASON, warn=False)
+        return [], {"family": None, "keys": {}}
+    family, gates = got
+    failures = check_floors(os.path.basename(fresh_path), gates)
+    base_name, base = None, None
+    if baseline_path:
+        bdoc = load_artifact(baseline_path)
+        bgot = classify(bdoc) if bdoc else None
+        if bgot:
+            base_name, base = os.path.basename(baseline_path), bgot[1]
+    else:
+        found = committed_baseline(family, results_dir)
+        if found:
+            base_name, base = found
+    report = {"family": family, "baseline": base_name,
+              "keys": {k: g.value for k, g in sorted(gates.items())}}
+    if base is None:
+        obs.degrade("regression.baseline", family, "record_only",
+                    _NOBASE_REASON, warn=False)
+        return failures, report
+    for k, g in sorted(gates.items()):
+        if k not in base:
+            continue            # new key: arms on the next baseline
+        if g.regressed_vs(base[k]):
+            failures.append(
+                f"{family}: {k} regressed {base[k].value:g} -> "
+                f"{g.value:g} (band {g.band:.0%}, {g.better} is better, "
+                f"baseline {base_name})")
+    for k in sorted(set(base) - set(gates)):
+        failures.append(f"{family}: key {k} present in baseline "
+                        f"{base_name} but missing from fresh artifact")
+    return failures, report
+
+
+def self_check(results_dir: str = RESULTS) -> Tuple[List[str], dict]:
+    """Floors over every committed artifact of a known family."""
+    failures: List[str] = []
+    families: Dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(results_dir, "*.json"))):
+        doc = load_artifact(path)
+        if doc is None:
+            continue
+        got = classify(doc)
+        if got is None:
+            continue            # legacy/unmatched schemas are summarized,
+        family, gates = got     # not gated — by design, not by accident
+        name = os.path.basename(path)
+        failures += check_floors(name, gates)
+        families.setdefault(family, {})[name] = {
+            k: g.value for k, g in sorted(gates.items())}
+    report = {"type": "regression_report", "mode": "self-check",
+              "families": families, "failures": failures,
+              "ok": not failures}
+    return failures, report
+
+
+def record_trajectory(report: dict, artifact: str,
+                      path: str = TRAJECTORY) -> None:
+    row = {"type": "trajectory", "ts": round(time.time(), 3),
+           "artifact": artifact, "family": report.get("family"),
+           "baseline": report.get("baseline"),
+           "keys": report.get("keys", {})}
+    with open(path, "a") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fresh", help="fresh artifact to gate against the "
+                    "committed baseline of its family")
+    ap.add_argument("--baseline", help="explicit baseline artifact "
+                    "(default: newest committed artifact of the family)")
+    ap.add_argument("--results-dir", default=RESULTS)
+    ap.add_argument("--record", action="store_true",
+                    help="append a trajectory row for the checked artifact")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report")
+    args = ap.parse_args(argv)
+
+    if args.fresh:
+        failures, report = check_fresh(args.fresh, args.baseline,
+                                       args.results_dir)
+        if args.record and report.get("family"):
+            record_trajectory(report, os.path.basename(args.fresh),
+                              os.path.join(args.results_dir,
+                                           "trajectory.jsonl"))
+    else:
+        failures, report = self_check(args.results_dir)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for f in failures:
+            print(f"REGRESSION: {f}")
+        if not failures:
+            n = (len(report.get("families", {}))
+                 or (1 if report.get("family") else 0))
+            print(f"regression gate: OK ({n} famil"
+                  f"{'y' if n == 1 else 'ies'} gated)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

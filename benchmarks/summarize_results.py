@@ -37,6 +37,24 @@ def rows_of(path: str):
 
 
 def fmt(r: dict) -> str:
+    if r.get("type") == "slo_report":            # live SLO engine snapshot
+        lines = [f"slo_report: healthy={r.get('healthy')} "
+                 f"breaches={r.get('total_breaches')} "
+                 f"(window={r.get('window')}, "
+                 f"min_samples={r.get('min_samples')})"]
+        for name, m in sorted((r.get("metrics") or {}).items()):
+            budget = m.get("budget") or 0
+            gate = (f"  budget {budget:g} "
+                    f"{'BREACHED' if m.get('breached') else 'ok'}"
+                    if budget else "  (untracked)")
+            lines.append(f"  {name:22s} p50={m.get('p50'):8.2f} "
+                         f"p99={m.get('p99'):8.2f} n={m.get('n')}{gate}")
+        return "\n   ".join(lines)
+    if r.get("type") == "trajectory":            # regression-gate ledger row
+        keys = " ".join(f"{k}={v:g}" for k, v in
+                        sorted((r.get("keys") or {}).items()))
+        return (f"trajectory[{r.get('family')}]: {r.get('artifact')} "
+                f"vs {r.get('baseline')}  {keys}")
     if "variant" in r:                           # fold microbench row
         if "error" in r:
             return f"variant={r['variant']:14s} ERROR {r['error'][:50]}"

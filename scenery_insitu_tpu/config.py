@@ -568,6 +568,71 @@ class ObsConfig:
     metrics_path: str = ""
     # Timer window for the embedded Timers (0 = runtime.stats_window).
     window: int = 0
+    # Fleet telemetry side-channel (obs/collector.py, docs/
+    # OBSERVABILITY.md "Fleet tracing"): the Collector's event SUB
+    # endpoint to PUB batched obs events/counters/ledger deltas to
+    # ("" = no side-channel). Loss-tolerant by construction: every send
+    # is non-blocking, a dead or slow collector costs drops (ledgered
+    # `obs.collector`), never a stalled render loop.
+    collector: str = ""
+    # The Collector's heartbeat ROUTER endpoint ("" = no clock-offset
+    # pings; batches then align on wall clocks alone).
+    collector_hb: str = ""
+    # Seconds between telemetry batch publishes (and heartbeat pings)
+    # on the session's frame loop.
+    collector_interval_s: float = 0.25
+
+    def __post_init__(self):
+        if self.collector_interval_s <= 0:
+            raise ValueError(f"collector_interval_s must be > 0, "
+                             f"got {self.collector_interval_s}")
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Live service-level objectives (obs/slo.py, docs/OBSERVABILITY.md
+    "SLO engine"): rolling-window p50/p99 estimators over frame latency,
+    serve staleness and camera-to-pixel latency, checked ON the run.
+
+    A budget of 0 disables that gate (the estimator still tracks the
+    metric for ``snapshot()``). A breach mints a typed ``slo_breach``
+    event, bumps the ``slo_breaches`` counter and lands one deduped
+    ``slo.breach`` ledger row — machine-readable health for the relay
+    tree's autoscale signal (ROADMAP item 2) and the elastic fleet's
+    frames-to-recover gate (item 5)."""
+
+    enabled: bool = False
+    # Rolling window, in samples per metric (p50/p99 are computed over
+    # at most this many most-recent observations — O(window) memory).
+    window: int = 128
+    # Breach checks need at least this many samples first (a p99 of 3
+    # frames is noise, not a signal).
+    min_samples: int = 16
+    # End-to-end frame latency budget, ms (sim -> delivered payload,
+    # the session's per-frame wall clock). 0 = no gate.
+    frame_p99_ms: float = 0.0
+    # Serve staleness budget: answers rendered from a VDI more than
+    # this many frames behind the stream head breach. 0 = no gate.
+    staleness_p99_frames: float = 0.0
+    # Camera-to-pixel budget, ms (camera request received -> answer
+    # bytes handed to the socket, measured on the serve tier). 0 = no
+    # gate.
+    camera_to_pixel_p99_ms: float = 0.0
+    # Per-phase budget, ms, applied to every recorded session phase
+    # span (sim/dispatch/fetch/sinks...). 0 = no gate.
+    phase_p99_ms: float = 0.0
+
+    def __post_init__(self):
+        if self.window < 8:
+            raise ValueError(f"slo.window must be >= 8, got {self.window}")
+        if self.min_samples < 1 or self.min_samples > self.window:
+            raise ValueError(f"need 1 <= min_samples <= window, got "
+                             f"{self.min_samples} (window {self.window})")
+        for k in ("frame_p99_ms", "staleness_p99_frames",
+                  "camera_to_pixel_p99_ms", "phase_p99_ms"):
+            if getattr(self, k) < 0:
+                raise ValueError(f"slo.{k} must be >= 0 (0 = no gate), "
+                                 f"got {getattr(self, k)}")
 
 
 @dataclass(frozen=True)
@@ -791,6 +856,7 @@ class FrameworkConfig:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     delta: DeltaConfig = field(default_factory=DeltaConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)

@@ -1,16 +1,21 @@
 """Observability layer: structured spans, device counters, the fallback
-ledger and Perfetto/JSONL exporters (docs/OBSERVABILITY.md).
+ledger, Perfetto/JSONL exporters, the fleet telemetry side-channel
+(``obs.collector``) and the live SLO engine (``obs.slo``)
+(docs/OBSERVABILITY.md).
 
 Import surface is intentionally tiny and JAX-free so hot modules
 (ops/*, io/*) can ``from scenery_insitu_tpu import obs`` at module load
 without cost or cycles; ``obs.device`` (the cost-analysis snapshot)
-touches JAX only inside its functions.
+touches JAX only inside its functions, and ``obs.collector`` touches
+zmq only inside its classes.
 """
 
 from scenery_insitu_tpu.obs.recorder import (Recorder, clear_ledger,
-                                             degrade, get_recorder,
+                                             counter_registry, degrade,
+                                             flight_flush, get_recorder,
                                              ledger, ledger_registry,
                                              set_recorder)
 
 __all__ = ["Recorder", "degrade", "ledger", "ledger_registry",
-           "clear_ledger", "get_recorder", "set_recorder"]
+           "counter_registry", "clear_ledger", "flight_flush",
+           "get_recorder", "set_recorder"]

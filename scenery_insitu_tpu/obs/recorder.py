@@ -133,6 +133,25 @@ _LEDGER_REGISTRY: Dict[str, str] = {
     "lod.inert": "lod.enabled is set but the session has no brick map "
                  "(composite.rebalance != bricks), so no per-brick "
                  "levels exist to plan; the replan is a no-op",
+    "obs.collector": "fleet telemetry side-channel: a batch publish to "
+                     "the collector could not complete without blocking "
+                     "(dead/slow collector, HWM full); the batch is "
+                     "dropped, the render loop never waits",
+    "obs.flight_recorder": "an unhandled exception tore down a frame "
+                           "loop; the last unflushed obs window was "
+                           "dumped best-effort to the configured "
+                           "trace/metrics paths",
+    "slo.breach": "the live SLO engine saw a rolling-window quantile "
+                  "cross its configured budget (metric and quantile in "
+                  "the reason); the run keeps going, the breach is the "
+                  "signal",
+    "regression.artifact": "regression_gate: a fresh bench artifact was "
+                           "unreadable or had no recognized schema; it "
+                           "is skipped, not silently passed",
+    "regression.baseline": "regression_gate: a committed baseline is "
+                           "missing or unrecognized for a requested "
+                           "comparison; that comparison is skipped and "
+                           "reported",
     "multihost.connect": "multihost.initialize could not reach the "
                          "coordinator on an attempt; retrying on the "
                          "bounded backoff ladder instead of hanging "
@@ -225,6 +244,129 @@ def ledger_registry() -> Dict[str, str]:
     meaning. Cross-validated against the AST-discovered site list by
     sitpu-lint's round-trip test; see docs/STATIC_ANALYSIS.md."""
     return dict(_LEDGER_REGISTRY)
+
+
+# The counter catalog — the static half of the counter contract,
+# mirroring _LEDGER_REGISTRY for ``Recorder.count`` names. sitpu-lint's
+# SITPU-COUNTER checker discovers the call sites by AST scan (string
+# literals passed to ``.count(...)`` plus the string defaults/keyword
+# literals of ``*_counter`` parameters, which parameterize the shared
+# ring builders in parallel/pipeline.py) and tests/test_lint.py holds
+# the two equal in both directions: a new ``rec.count("name")`` must
+# register its name here, and a registry row without a live site must
+# go. Keys are counter names, values say what one increment means.
+_COUNTER_REGISTRY: Dict[str, str] = {
+    "bricks_steps_built": "a brick-partition render step was compiled "
+                          "for a (brick map, camera) combination",
+    "build_steps": "the session (re)built its compiled render step set",
+    "compile_scan_block": "a temporal scan frame-block was compiled",
+    "compile_step": "one render/serve executable was compiled (lowered "
+                    "+ jitted)",
+    "dcn_bytes_received": "bytes received over the inter-host DCN seam "
+                          "by the hierarchical composite",
+    "dcn_bytes_sent": "bytes sent over the inter-host DCN seam by the "
+                      "hierarchical composite",
+    "dcn_hops_built": "one DCN ring hop of the hierarchical exchange "
+                      "was built",
+    "delta_bytes_saved": "wire bytes avoided by a temporal-delta "
+                         "(SKIP/P) record vs the full I-tile encoding",
+    "delta_march_skipped": "a rank's re-march was skipped because its "
+                           "occupancy range signature was unchanged",
+    "delta_tiles_skipped": "an unchanged tile shipped as a SKIP record",
+    "flight_dumps": "the flight recorder dumped the last obs window "
+                    "after an unhandled frame-loop exception",
+    "frame_scan_builds": "a per-frame scan build was dispatched",
+    "frames_abandoned": "the tile assembler abandoned a frame that "
+                        "stayed incomplete past its window",
+    "frames_eager_dispatch": "a frame went through the eager per-frame "
+                             "dispatch path",
+    "frames_scan_dispatch": "a frame was delivered from a compiled scan "
+                            "block",
+    "head_degraded_frames": "the head composited a frame with >= 1 rank "
+                            "missing (degraded flag set)",
+    "head_ranks_down": "head liveness marked a render rank silent",
+    "head_ranks_readmitted": "a silent render rank resumed and was "
+                             "readmitted to the composite",
+    "hier_composite_builds": "a two-level hierarchical composite "
+                             "schedule was built",
+    "hier_plain_levels": "a plain (non-ring) allgather level of the "
+                         "hierarchical exchange was built",
+    "iframe_forced": "the delta encoder forced a full I-tile (resync or "
+                     "cadence)",
+    "ingest_stall_recoveries": "shm ingest saw a strictly-newer producer "
+                               "frame again after a stall",
+    "ingest_stalls": "shm ingest found no strictly-newer producer frame "
+                     "past frame_timeout_ms",
+    "obs_batch_drops": "a fleet-telemetry batch was dropped because the "
+                       "collector socket would have blocked",
+    "obs_batches_published": "a fleet-telemetry batch was handed to the "
+                             "collector PUB socket",
+    "occupancy_kbudget_builds": "a K-budget occupancy plan was built",
+    "occupancy_pyramid_builds": "an occupancy pyramid was (re)built",
+    "occupancy_ranges_builds": "a brick range-signature set was built",
+    "rebalance_replans": "a rebalance replan (slab or brick-steal) was "
+                         "executed",
+    "rebalance_steps_built": "a render step was compiled for a "
+                             "rebalanced partition",
+    "regime_switches": "the session switched between scan and eager "
+                       "dispatch regimes",
+    "reuse_steps_built": "a temporal-reuse render step (carried "
+                         "fragments) was built",
+    "ring_exchange_builds": "a ring all-to-all exchange program was "
+                            "built",
+    "ring_steps_built": "one hop of a ring exchange was built",
+    "scan_blocks_dispatched": "a compiled scan block was dispatched",
+    "scan_tail_eager_frames": "tail frames finished eagerly after a "
+                              "partial scan block (count = frames)",
+    "serve_answers": "the edge server sent one answer to a viewer",
+    "serve_batch_cameras": "cameras rendered inside batched serve "
+                           "dispatches (count = cameras)",
+    "serve_batches": "the edge server ran one batched render dispatch",
+    "serve_bytes_out": "bytes sent to viewers by the edge server",
+    "serve_cache_hits": "a viewer camera hit the camera-delta cache",
+    "serve_client_drops": "a malformed/oversized client message was "
+                          "dropped by the serve loop",
+    "serve_clients_evicted": "an idle viewer was evicted from the edge "
+                             "server",
+    "serve_frames_adopted": "the serve loop adopted a new VDI frame "
+                            "from the stream",
+    "serve_proxy_builds": "a planar-reprojection proxy renderer was "
+                          "built",
+    "serve_requests": "the edge server received one client camera "
+                      "request",
+    "serve_requests_coalesced": "duplicate per-frame camera requests "
+                                "were coalesced into one render",
+    "serve_sheds": "admission control refused a viewer or camera "
+                   "request",
+    "serve_stale_answers": "an answer was rendered from a VDI beyond "
+                           "the staleness budget (stamped stale)",
+    "sink_failures": "a frame/tile sink or steering callback raised",
+    "sinks_quarantined": "a sink was disabled after repeated "
+                         "consecutive failures",
+    "slo_breaches": "the live SLO engine recorded one budget breach",
+    "steering_drops": "a malformed steering message was dropped",
+    "stream_drops": "a stream message was dropped (integrity or "
+                    "continuity validation)",
+    "stream_gap_messages": "a sequence gap/duplicate/reorder was "
+                           "observed on a stream",
+    "stream_reconnects": "a stream endpoint reconnected after a "
+                         "liveness timeout",
+    "tf_steps_reused": "a steered transfer function restored its cached "
+                       "compiled steps",
+    "tf_updates": "a steered transfer-function update was applied",
+    "tiles_delivered": "the assembler delivered one complete tile",
+    "wave_schedule_builds": "a tile-wave overlap schedule was built",
+    "wave_steps_built": "a tile-wave render step was compiled",
+    "wire_encode_builds": "a wire encode executable was built",
+}
+
+
+def counter_registry() -> Dict[str, str]:
+    """The static name catalog of ``Recorder.count`` counters — every
+    counter a call site in this repo can bump, with a one-line meaning.
+    Cross-validated against the AST-discovered site list by sitpu-lint's
+    SITPU-COUNTER round-trip test; see docs/OBSERVABILITY.md."""
+    return dict(_COUNTER_REGISTRY)
 
 
 def ledger() -> List[Dict[str, Any]]:
@@ -441,6 +583,35 @@ class Recorder:
             self.export_chrome_trace(self.trace_path)
         if self.metrics_path:
             self.export_metrics_jsonl(self.metrics_path)
+
+
+# ------------------------------------------------------ flight recorder
+
+_FLIGHT_REASON = ("unhandled exception tore down the frame loop; the "
+                  "last obs window was dumped best-effort to the "
+                  "configured paths")
+
+
+def flight_flush(rec: Optional[Recorder] = None,
+                 where: str = "run") -> bool:
+    """Crash-path dump: write whatever the recorder holds to its
+    configured sinks, best-effort, so an exception mid-run does not lose
+    the final unflushed window (the one that usually explains the
+    crash). Never raises — this runs while the original exception is
+    propagating, and a broken disk must not mask it. Returns True when
+    a dump was attempted (enabled recorder with >= 1 sink path)."""
+    rec = rec or get_recorder()
+    if not rec.enabled or not (rec.trace_path or rec.metrics_path):
+        return False
+    rec.count("flight_dumps")
+    rec.event("flight_dump", where=where)
+    degrade("obs.flight_recorder", where, "crash_flush", _FLIGHT_REASON,
+            warn=False)
+    try:
+        rec.flush()
+    except Exception:
+        pass    # the in-flight exception is the story, not this one
+    return True
 
 
 # ------------------------------------------------------- global recorder

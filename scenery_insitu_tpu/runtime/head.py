@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from scenery_insitu_tpu import obs as _obs
+from scenery_insitu_tpu.obs.collector import lineage, trace_ctx
 from scenery_insitu_tpu.runtime.failsafe import SinkGuard
 from scenery_insitu_tpu.runtime.streaming import _msgpack, _zmq
 
@@ -50,8 +51,10 @@ class RankImageSender:
         header = _msgpack().packb({
             "rank": self.rank, "frame": int(frame),
             "image_shape": list(image.shape),
-            "depth_shape": list(depth.shape)})
+            "depth_shape": list(depth.shape),
+            "tc": trace_ctx(frame, self.rank)})
         self.sock.send_multipart([header, image.tobytes(), depth.tobytes()])
+        lineage("head", "send", int(frame), rank=self.rank)
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -172,6 +175,7 @@ class HeadNode:
             payload["missing_ranks"] = missing
             self.frames_degraded += 1
             _obs.get_recorder().count("head_degraded_frames")
+        lineage("composite", "send", frame, ranks=len(ranks))
         self._guard.run(self.sinks, frame, payload, kind="head sink")
 
     def pump(self, timeout_ms: int = 100) -> int:
@@ -215,6 +219,7 @@ class HeadNode:
                     "mismatch)", warn=False)
                 timeout_ms = 0
                 continue
+            lineage("head", "recv", frame, ctx=h.get("tc"), rank=rank)
             if self._newest is not None and \
                     abs(frame - self._newest) > self._max_jump:
                 # a frame index wildly outside the plausible window —

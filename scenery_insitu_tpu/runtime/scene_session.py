@@ -46,6 +46,10 @@ class SceneSession:
         self.timers = self.obs.timers
         # always take over the process slot (see InSituSession.__init__)
         _obs.set_recorder(self.obs)
+        # same live SLO engine as InSituSession — the driver paces the
+        # loop, so frame_ms is observed per render_frame call
+        from scenery_insitu_tpu.obs.slo import SLOEngine
+        self.slo = SLOEngine(self.cfg.slo, recorder=self.obs)
         self.tf = tf or for_dataset(self.cfg.runtime.dataset)
         self.camera = camera or Camera.create(
             (0.0, 0.6, 3.0), fov_y_deg=50.0, near=0.3, far=20.0)
@@ -114,6 +118,9 @@ class SceneSession:
         from scenery_insitu_tpu.runtime.session import (
             advance_camera_and_index, drain_steering)
 
+        import time as _time
+
+        t_f = _time.perf_counter()
         drain_steering(self)
         with self.obs.span("dispatch", frame=self.frame_index,
                            engine=self.engine,
@@ -147,6 +154,8 @@ class SceneSession:
             self._sink_guard.run(self.sinks, self.frame_index, payload)
         advance_camera_and_index(self)
         self.timers.frame_done()
+        self.slo.observe("frame_ms", (_time.perf_counter() - t_f) * 1e3,
+                         frame=self.frame_index - 1)
         # the driver paces this loop (no run() bracket to flush at), so
         # write the obs sinks at every stats-window boundary — flush()
         # rewrites whole snapshots, so the files are always loadable

@@ -20,6 +20,7 @@ externally by supplying a custom sim adapter (anything with
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -306,6 +307,21 @@ class InSituSession:
         # and a stale enabled recorder from a finished session would
         # otherwise keep absorbing this session's events
         _obs.set_recorder(self.obs)
+        # live SLO engine (docs/OBSERVABILITY.md "SLO engine"): rolling
+        # p50/p99 over frame latency + per-phase budgets, checked on the
+        # loop; session.slo.snapshot() is the health signal
+        from scenery_insitu_tpu.obs.slo import SLOEngine
+        self.slo = SLOEngine(self.cfg.slo, recorder=self.obs)
+        # fleet telemetry side-channel (docs/OBSERVABILITY.md "Fleet
+        # tracing"): obs.collector configured -> batched event publish
+        # on the frame loop, non-blocking, drops ledgered
+        self._obs_pub = None
+        if self.cfg.obs.collector:
+            from scenery_insitu_tpu.obs.collector import ObsPublisher
+            self._obs_pub = ObsPublisher(
+                self.cfg.obs.collector, self.cfg.obs.collector_hb,
+                rank=self.obs.rank,
+                interval_s=self.cfg.obs.collector_interval_s)
         if sim is not None:
             self.sim = sim
         elif self.cfg.sim.kind in ("lennard_jones", "sho"):
@@ -621,21 +637,38 @@ class InSituSession:
 
         ctx = (jax.profiler.trace(profile_dir) if profile_dir
                else contextlib.nullcontext())
-        with ctx:
-            pending = None
-            payload = {}
-            for i in range(frames):
-                out = self.render_frame()
+        try:
+            with ctx:
+                pending = None
+                payload = {}
+                for i in range(frames):
+                    t_f = time.perf_counter()
+                    out = self.render_frame()
+                    if pending is not None and fetch:
+                        payload = self._fetch(*pending)
+                    pending = (self.frame_index - 1, out)
+                    self.timers.frame_done()
+                    self.slo.observe(
+                        "frame_ms",
+                        (time.perf_counter() - t_f) * 1e3,
+                        frame=self.frame_index - 1)
+                    if self._obs_pub is not None:
+                        self._obs_pub.pump(self.obs)
                 if pending is not None and fetch:
                     payload = self._fetch(*pending)
-                pending = (self.frame_index - 1, out)
-                self.timers.frame_done()
-            if pending is not None and fetch:
-                payload = self._fetch(*pending)
+        except BaseException:
+            # flight recorder: an unhandled exception must not lose the
+            # final unflushed obs window — dump it, then keep raising
+            _obs.flight_flush(self.obs, where="run")
+            if self._obs_pub is not None:
+                self._obs_pub.pump(self.obs, force=True)
+            raise
         # end-of-run teardown: the final partial window frame_done never
         # reached, the whole-run totals, and the obs sinks
         self.timers.dump_totals()
         self.obs.flush()
+        if self._obs_pub is not None:
+            self._obs_pub.pump(self.obs, force=True)
         return payload
 
     def _fetch(self, index: int, out) -> dict:
@@ -1051,120 +1084,147 @@ class InSituSession:
         ctx = (jax.profiler.trace(profile_dir) if profile_dir
                else contextlib.nullcontext())
         payload = {}
-        with ctx:
-            done = 0
-            while done < frames:
-                block = min(self.cfg.runtime.scan_frames, frames - done)
-                drain_steering(self)
-                self._maybe_replan()
-                # host replay of the block's camera ladder — frame i of
-                # the scan renders with exactly this camera (orbit is
-                # applied identically in-scan)
-                cams = [self.camera]
-                for _ in range(block - 1):
-                    cams.append(orbit(cams[-1],
-                                      jnp.float32(self.orbit_rate)))
-                mxu = self._step is None
-                regime = None
-                crossing = False
-                if mxu:
-                    regimes = {self._slicer.choose_axis(c) for c in cams}
-                    crossing = len(regimes) > 1
-                # eager fallback for blocks the cached scan executable
-                # cannot serve: a regime crossing (the step is
-                # regime-specialized) or a short TAIL block (compiling a
-                # one-off scan of the whole pipeline for a different
-                # length costs far more than the frames it would save)
-                if crossing or block < self.cfg.runtime.scan_frames:
-                    if crossing:
-                        self.log(f"scan_frames: march regime crossing "
-                                 f"inside a {block}-frame block — running "
-                                 "it eagerly")
-                        _obs.degrade(
-                            "session.scan_block", "scan", "eager",
-                            "march regime crossing inside a block",
-                            warn=False)
-                    else:
-                        # a tail block is expected on long runs, but it
-                        # still ran eagerly — the ledger must say so (a
-                        # run SHORTER than scan_frames is all tail, and
-                        # an empty ledger would read as "scan was live")
-                        self.obs.count("scan_tail_eager_frames", block)
-                        self.log(f"scan_frames: {block}-frame tail block "
-                                 "below the scan length — running it "
-                                 "eagerly")
-                        _obs.degrade(
-                            "session.scan_block", "scan", "eager",
-                            "tail block shorter than scan_frames",
-                            warn=False)
-                    for _ in range(block):
-                        out = self.render_frame()
-                        if fetch:
-                            payload = self._fetch(self.frame_index - 1,
-                                                  out)
-                        self.timers.frame_done()
-                    done += block
-                    continue
-                if mxu:
-                    regime = next(iter(regimes))
-                    if self._temporal:
-                        self._enter_regime(regime)
-                runner, seed = self._scan_runner(block, regime)
-                self.obs.count("scan_blocks_dispatched")
-                self.obs.count("frames_scan_dispatch", block)
-                with self.obs.span("dispatch", frame=self.frame_index,
-                                   scan_block=block,
-                                   regime=str(regime)):
-                    args = (self.sim.state, self._origin, self._spacing,
-                            self.camera, jnp.float32(self.orbit_rate))
-                    if self._temporal:
-                        thr = self._mxu_thr.get(regime)
-                        if thr is None:
-                            field = shard_volume(self.sim.field, self.mesh)
-                            thr = seed(field, self._origin, self._spacing,
-                                       self.camera)
-                        (st, cam, thr2), outs = runner(*args, thr)
-                        self._mxu_thr[regime] = thr2
-                    else:
-                        (st, cam, _), outs = runner(*args)
-                self.sim.state = st
-                self.camera = cam
-                start = self.frame_index
-                self.frame_index += block
-                if fetch:
-                    vdi = outs[0] if mxu else outs
-                    metas = outs[1] if mxu else None
-                    with self.obs.span("fetch", frame=start,
-                                       scan_block=block):
-                        color = np.asarray(vdi.color)
-                        depth = np.asarray(vdi.depth)
-                    for i in range(block):
-                        idx = start + i
-                        if metas is not None:
-                            meta = jax.tree_util.tree_map(
-                                lambda x, i=i: x[i], metas)
-                            meta = meta._replace(index=jnp.int32(idx))
-                        else:
-                            meta = self.frame_metadata(idx, camera=cams[i])
-                        if self.tile_sinks \
-                                and self.cfg.composite.schedule == "waves":
-                            self._deliver_tiles(idx, None, meta,
-                                                color=color[i],
-                                                depth=depth[i])
-                        payload = {"vdi_color": color[i],
-                                   "vdi_depth": depth[i],
-                                   "frame": idx, "meta": meta}
-                        with self.obs.span("sinks", frame=idx):
-                            self._sink_guard.run(self.sinks, idx,
-                                                 payload)
-                        self.timers.frame_done()
-                else:
-                    for _ in range(block):
-                        self.timers.frame_done()
-                done += block
+        try:
+            with ctx:
+                payload = self._scan_loop(frames, fetch, payload)
+        except BaseException:
+            # flight recorder (same contract as the eager loop)
+            _obs.flight_flush(self.obs, where="run_scan")
+            if self._obs_pub is not None:
+                self._obs_pub.pump(self.obs, force=True)
+            raise
         self.timers.dump_totals()
         self.obs.flush()
+        if self._obs_pub is not None:
+            self._obs_pub.pump(self.obs, force=True)
         return payload
+
+    def _scan_loop(self, frames: int, fetch: bool, payload: dict) -> dict:
+        done = 0
+        while done < frames:
+            t_blk = time.perf_counter()
+            block = min(self.cfg.runtime.scan_frames, frames - done)
+            drain_steering(self)
+            self._maybe_replan()
+            # host replay of the block's camera ladder — frame i of
+            # the scan renders with exactly this camera (orbit is
+            # applied identically in-scan)
+            cams = [self.camera]
+            for _ in range(block - 1):
+                cams.append(orbit(cams[-1],
+                                  jnp.float32(self.orbit_rate)))
+            mxu = self._step is None
+            regime = None
+            crossing = False
+            if mxu:
+                regimes = {self._slicer.choose_axis(c) for c in cams}
+                crossing = len(regimes) > 1
+            # eager fallback for blocks the cached scan executable
+            # cannot serve: a regime crossing (the step is
+            # regime-specialized) or a short TAIL block (compiling a
+            # one-off scan of the whole pipeline for a different
+            # length costs far more than the frames it would save)
+            if crossing or block < self.cfg.runtime.scan_frames:
+                if crossing:
+                    self.log(f"scan_frames: march regime crossing "
+                             f"inside a {block}-frame block — running "
+                             "it eagerly")
+                    _obs.degrade(
+                        "session.scan_block", "scan", "eager",
+                        "march regime crossing inside a block",
+                        warn=False)
+                else:
+                    # a tail block is expected on long runs, but it
+                    # still ran eagerly — the ledger must say so (a
+                    # run SHORTER than scan_frames is all tail, and
+                    # an empty ledger would read as "scan was live")
+                    self.obs.count("scan_tail_eager_frames", block)
+                    self.log(f"scan_frames: {block}-frame tail block "
+                             "below the scan length — running it "
+                             "eagerly")
+                    _obs.degrade(
+                        "session.scan_block", "scan", "eager",
+                        "tail block shorter than scan_frames",
+                        warn=False)
+                for _ in range(block):
+                    out = self.render_frame()
+                    if fetch:
+                        payload = self._fetch(self.frame_index - 1,
+                                              out)
+                    self.timers.frame_done()
+                self._scan_block_done(t_blk, block)
+                done += block
+                continue
+            if mxu:
+                regime = next(iter(regimes))
+                if self._temporal:
+                    self._enter_regime(regime)
+            runner, seed = self._scan_runner(block, regime)
+            self.obs.count("scan_blocks_dispatched")
+            self.obs.count("frames_scan_dispatch", block)
+            with self.obs.span("dispatch", frame=self.frame_index,
+                               scan_block=block,
+                               regime=str(regime)):
+                args = (self.sim.state, self._origin, self._spacing,
+                        self.camera, jnp.float32(self.orbit_rate))
+                if self._temporal:
+                    thr = self._mxu_thr.get(regime)
+                    if thr is None:
+                        field = shard_volume(self.sim.field, self.mesh)
+                        thr = seed(field, self._origin, self._spacing,
+                                   self.camera)
+                    (st, cam, thr2), outs = runner(*args, thr)
+                    self._mxu_thr[regime] = thr2
+                else:
+                    (st, cam, _), outs = runner(*args)
+            self.sim.state = st
+            self.camera = cam
+            start = self.frame_index
+            self.frame_index += block
+            if fetch:
+                vdi = outs[0] if mxu else outs
+                metas = outs[1] if mxu else None
+                with self.obs.span("fetch", frame=start,
+                                   scan_block=block):
+                    color = np.asarray(vdi.color)
+                    depth = np.asarray(vdi.depth)
+                for i in range(block):
+                    idx = start + i
+                    if metas is not None:
+                        meta = jax.tree_util.tree_map(
+                            lambda x, i=i: x[i], metas)
+                        meta = meta._replace(index=jnp.int32(idx))
+                    else:
+                        meta = self.frame_metadata(idx, camera=cams[i])
+                    if self.tile_sinks \
+                            and self.cfg.composite.schedule == "waves":
+                        self._deliver_tiles(idx, None, meta,
+                                            color=color[i],
+                                            depth=depth[i])
+                    payload = {"vdi_color": color[i],
+                               "vdi_depth": depth[i],
+                               "frame": idx, "meta": meta}
+                    with self.obs.span("sinks", frame=idx):
+                        self._sink_guard.run(self.sinks, idx,
+                                             payload)
+                    self.timers.frame_done()
+            else:
+                for _ in range(block):
+                    self.timers.frame_done()
+            self._scan_block_done(t_blk, block)
+            done += block
+        return payload
+
+    def _scan_block_done(self, t_blk: float, block: int) -> None:
+        """Per-block SLO + telemetry bookkeeping: the block's wall clock
+        amortizes over its frames (the scan's per-frame latency is the
+        block mean by construction)."""
+        dt_ms = (time.perf_counter() - t_blk) * 1e3 / max(1, block)
+        for i in range(block):
+            self.slo.observe("frame_ms", dt_ms,
+                             frame=self.frame_index - block + i)
+        if self._obs_pub is not None:
+            self._obs_pub.pump(self.obs)
 
     def prewarm_regimes(self, regimes=None) -> dict:
         """Precompile the distributed MXU step for each (axis, sign) march

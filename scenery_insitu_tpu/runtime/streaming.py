@@ -47,6 +47,7 @@ from typing import Callable, Iterator, Optional, Tuple, Union
 import numpy as np
 
 from scenery_insitu_tpu import obs as _obs
+from scenery_insitu_tpu.obs.collector import lineage, trace_ctx
 from scenery_insitu_tpu.config import DeltaConfig, FaultConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.vdi import VDI, VDIMetadata
@@ -308,8 +309,9 @@ class VDIPublisher(_HeartbeatPacer):
               tile: Optional[dict]) -> int:
         from scenery_insitu_tpu import obs as _obs
 
+        fidx = int(np.asarray(meta.index))
         with _obs.get_recorder().span(
-                "encode", frame=int(np.asarray(meta.index)),
+                "encode", frame=fidx,
                 sink="vdi_publisher", codec=self.codec,
                 precision=self.precision,
                 **({"tile": tile["tile"]} if tile else {})):
@@ -362,7 +364,13 @@ class VDIPublisher(_HeartbeatPacer):
                 "depth_shape": list(depth.shape),
                 "meta": {f: np.asarray(getattr(meta, f)).tolist()
                          for f in _META_FIELDS},
+                # frame lineage (docs/OBSERVABILITY.md "Fleet tracing"):
+                # frame id + origin rank + origin wall clock ride every
+                # frame-bytes message; old decoders ignore unknown keys
+                "tc": trace_ctx(fidx, _obs.get_recorder().rank),
             }
+        lineage("tile" if tile else "publish", "send", fidx,
+                **({"tile": tile["tile"]} if tile else {}))
         with self._send_lock:
             # seq is minted INSIDE the lock: a background heartbeat
             # claiming a later seq but reaching the wire first would
@@ -718,6 +726,8 @@ class VDISubscriber(_ReconnectSupervisor):
                 return self._drop("integrity", f"decode failed: {e!r}",
                                   epoch, seq, fidx)
             self.stats["frames"] += 1
+            lineage("tile" if h.get("tile") else "publish", "recv",
+                    fidx, ctx=h.get("tc"))
             return VDI(color, depth), meta, h.get("tile")
         try:
             if precision == "qpack8":
@@ -738,6 +748,8 @@ class VDISubscriber(_ReconnectSupervisor):
             return self._drop("integrity", f"decode failed: {e!r}",
                               epoch, seq, fidx)
         self.stats["frames"] += 1
+        lineage("tile" if h.get("tile") else "publish", "recv",
+                fidx, ctx=h.get("tc"))
         return VDI(color, depth), meta, h.get("tile")
 
     @staticmethod
@@ -1093,13 +1105,17 @@ class VideoStreamer:
     DistributedVolumeRenderer.kt:275-291). This image ships no
     ffmpeg/libx264, so frames go out as JPEG (cv2.imencode) — the MJPEG
     transport role of the reference's stream, same socket shape. Frames
-    larger than one datagram are chunked ``[magic, frame, part, nparts |
-    payload]``; receivers reassemble and drop incomplete frames (UDP
-    semantics: newest complete frame wins, stalls never block the
-    renderer)."""
+    larger than one datagram are chunked ``[magic, frame, part, nparts,
+    t_origin | payload]``; receivers reassemble and drop incomplete
+    frames (UDP semantics: newest complete frame wins, stalls never
+    block the renderer). ``t_origin`` (f64 unix seconds, stamped once
+    per frame) is the frame-lineage trace context of this hop
+    (docs/OBSERVABILITY.md "Fleet tracing")."""
 
     MAGIC = b"SIVD"
     CHUNK = 60000
+    HEADER = "!4sIHHd"
+    HEADER_BYTES = 20
 
     def __init__(self, host: str = "127.0.0.1", port: int = 3337,
                  quality: int = 85, gamma: float = 2.2):
@@ -1131,11 +1147,14 @@ class VideoStreamer:
         blob = jpg.tobytes()
         nparts = -(-len(blob) // self.CHUNK)
         sent = 0
+        t_origin = time.time()
         for p in range(nparts):
             payload = blob[p * self.CHUNK:(p + 1) * self.CHUNK]
-            head = struct.pack("!4sIHH", self.MAGIC,
-                               self.frame_id & 0xFFFFFFFF, p, nparts)
+            head = struct.pack(self.HEADER, self.MAGIC,
+                               self.frame_id & 0xFFFFFFFF, p, nparts,
+                               t_origin)
             sent += self.sock.sendto(head + payload, self.addr)
+        lineage("video", "send", self.frame_id)
         # wrap in lockstep with the u32 wire field — the receiver's
         # eviction compares in wrap-aware sequence space (seq_delta)
         self.frame_id = (self.frame_id + 1) & SEQ_MASK
@@ -1172,13 +1191,15 @@ class VideoReceiver:
                 pkt, _ = self.sock.recvfrom(65536)
             except (_socket.timeout, TimeoutError):
                 return None
-            if len(pkt) < 12 or pkt[:4] != VideoStreamer.MAGIC:
+            hb = VideoStreamer.HEADER_BYTES
+            if len(pkt) < hb or pkt[:4] != VideoStreamer.MAGIC:
                 continue
-            _, fid, part, nparts = struct.unpack("!4sIHH", pkt[:12])
+            _, fid, part, nparts, t_origin = struct.unpack(
+                VideoStreamer.HEADER, pkt[:hb])
             if nparts == 0 or part >= nparts:
                 continue                                   # corrupt/foreign
             parts = self._parts.setdefault(fid, {})
-            parts[part] = pkt[12:]
+            parts[part] = pkt[hb:]
             # evict incomplete older frames (lost datagrams must not
             # leak) — wrap-aware: the u32 frame id wraps on long
             # streams, and an unwrapped `f < fid - 4` would both leak
@@ -1193,6 +1214,8 @@ class VideoReceiver:
                                    cv2.IMREAD_COLOR)
                 if img is None:
                     continue
+                lineage("viewer", "recv", int(fid),
+                        ctx={"frame": int(fid), "t": t_origin})
                 return img[:, :, ::-1]                     # BGR -> RGB
         return None
 

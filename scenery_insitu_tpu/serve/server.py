@@ -48,6 +48,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from scenery_insitu_tpu import obs as _obs
+from scenery_insitu_tpu.obs.collector import lineage, trace_ctx
+from scenery_insitu_tpu.obs.slo import SLOEngine
 from scenery_insitu_tpu.config import (FaultConfig, FrameworkConfig,
                                        ServeConfig)
 from scenery_insitu_tpu.core.camera import Camera
@@ -201,6 +203,9 @@ class ViewerServer:
                       "batch_cameras": 0, "client_drops": 0,
                       "evictions": 0, "coalesced": 0, "proxy_builds": 0,
                       "stream_drops": 0}
+        # live SLO checks on the answer path (docs/OBSERVABILITY.md
+        # "SLO engine"): camera-to-pixel latency + answer staleness
+        self.slo = SLOEngine(cfg.slo)
 
     # ------------------------------------------------------------ stream
     def pump_stream(self, timeout_ms: int = 0,
@@ -549,7 +554,9 @@ class ViewerServer:
                 # rendered — a frozen stale=False would break the
                 # bounded-staleness contract
                 fields = dict(cl.cache_fields, seq=req.seq, cached=True,
-                              stale=bool(stale))
+                              stale=bool(stale),
+                              tc=trace_ctx(fidx,
+                                           _obs.get_recorder().rank))
                 self.sock.send_multipart(
                     [ident, _msgpack().packb(fields), cl.cache_blob])
                 self.stats["cache_hits"] += 1
@@ -561,6 +568,7 @@ class ViewerServer:
                 if stale:
                     self.stats["stale_answers"] += 1
                     _obs.get_recorder().count("serve_stale_answers")
+                self._observe_answer(req, fidx, stale)
                 served += 1
                 continue
             gkey = ("exact", None) if cl.tier == "exact" \
@@ -598,6 +606,19 @@ class ViewerServer:
                     served += 1
         return served
 
+    def _observe_answer(self, req: _Request, fidx: int,
+                        stale: bool) -> None:
+        """Per-answer telemetry: camera-to-pixel latency and answer
+        staleness feed the SLO engine; one ``serve`` lineage hop joins
+        the frame's fleet-trace arc."""
+        c2p_ms = (time.monotonic() - req.t_in) * 1e3
+        self.slo.observe("camera_to_pixel_ms", c2p_ms, frame=fidx)
+        if self.newest is not None:
+            self.slo.observe("staleness_frames",
+                             max(0, self.newest - fidx), frame=fidx)
+        lineage("serve", "send", fidx, seq=req.seq, stale=bool(stale),
+                cam_to_pix_ms=round(c2p_ms, 3))
+
     def _reply(self, req: _Request, img: np.ndarray, fidx: int,
                stale: bool) -> None:
         cl = self.clients.get(req.ident)
@@ -614,7 +635,8 @@ class ViewerServer:
         fields = {"type": "frame", "frame": fidx, "seq": req.seq,
                   "tier": tier, "stale": bool(stale), "cached": False,
                   "shape": list(payload.shape), "dtype": dtype,
-                  "crc": zlib.crc32(blob)}
+                  "crc": zlib.crc32(blob),
+                  "tc": trace_ctx(fidx, _obs.get_recorder().rank)}
         self.sock.send_multipart([req.ident, _msgpack().packb(fields),
                                   blob])
         self.stats["answers"] += 1
@@ -624,6 +646,7 @@ class ViewerServer:
         if stale:
             self.stats["stale_answers"] += 1
             rec.count("serve_stale_answers")
+        self._observe_answer(req, fidx, stale)
         if cl is not None:
             cl.cache_frame = self._adoption
             cl.cache_tier = tier
@@ -652,10 +675,21 @@ class ViewerServer:
         (None = forever on that axis); returns the stats snapshot."""
         deadline = None if seconds is None else time.monotonic() + seconds
         answers = 0
-        while (deadline is None or time.monotonic() < deadline) and \
-                (max_answers is None or answers < max_answers):
-            answers += self.run_once(timeout_ms=20)
+        try:
+            while (deadline is None or time.monotonic() < deadline) and \
+                    (max_answers is None or answers < max_answers):
+                answers += self.run_once(timeout_ms=20)
+        except BaseException:
+            # flight recorder: the serve loop died — dump the recorder's
+            # last window before the exception erases it
+            _obs.flight_flush(where="serve")
+            raise
         return dict(self.stats)
+
+    def slo_snapshot(self) -> dict:
+        """The SLO engine's machine-readable health record for THIS
+        edge (camera-to-pixel + staleness quantiles vs budget)."""
+        return self.slo.snapshot()
 
     def close(self) -> None:
         self.sock.close(linger=0)

@@ -560,6 +560,63 @@ def run_matrix(seed: int = 0, frames: int = 12) -> dict:
     scenario("serve/delta_resync_midjoin", ["stream.delta_resync"],
              serve_delta_midjoin)
 
+    # --- telemetry collector dies mid-run (ISSUE 17) --------------------
+    def collector_death():
+        """The fleet-telemetry collector is killed halfway through the
+        run: every frame still crosses the delivery plane (telemetry is
+        a side-channel, never on the frame path), and the presumed-lost
+        batches are counted and ledgered ``obs.collector``."""
+        from scenery_insitu_tpu.obs.collector import (Collector,
+                                                      ObsPublisher)
+        from scenery_insitu_tpu.runtime.streaming import (StreamDrop,
+                                                          VDIPublisher,
+                                                          VDISubscriber)
+
+        saved_rec = obs.get_recorder()
+        rec = obs.Recorder(enabled=True)
+        obs.set_recorder(rec)
+        col = Collector()
+        opub = ObsPublisher(col.endpoint, col.hb_endpoint, rank=0,
+                            interval_s=0.0)
+        pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib")
+        sub = VDISubscriber(pub.endpoint)
+        killed_at = frames // 2
+        try:
+            time.sleep(0.2)
+            alive_batches = 0
+            for i in range(frames):
+                if i == killed_at:
+                    col.close()          # mid-run, no goodbye
+                pub.publish(vdi, meta._replace(index=np.int32(i)))
+                opub.pump(rec, force=True)
+                if i < killed_at:
+                    alive_batches += col.poll(20)
+            received = []
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                got = sub.receive_tile(timeout_ms=100)
+                if got is None:
+                    break
+                if not isinstance(got, StreamDrop):
+                    received.append(got)
+            # the delivery plane never noticed: EVERY frame arrived
+            assert len(received) == frames, \
+                f"delivery impacted: {len(received)}/{frames}"
+            assert alive_batches > 0          # telemetry flowed before
+            assert opub.drops > 0             # ...and was ledgered after
+            assert rec.counters.get("obs_batch_drops", 0) > 0
+            return {"frames_received": len(received),
+                    "batches_before_kill": alive_batches,
+                    "publisher": {"batches": opub.batches,
+                                  "drops": opub.drops}}
+        finally:
+            obs.set_recorder(saved_rec)
+            opub.close()
+            pub.close()
+            sub.close()
+    scenario("obs/collector_death_midrun", ["obs.collector"],
+             collector_death)
+
     # --- subscriber liveness reconnect ----------------------------------
     def liveness():
         sub = VDISubscriber(

@@ -1,0 +1,157 @@
+"""SITPU-COUNTER — counter-catalog completeness.
+
+The contract (PR 17, docs/OBSERVABILITY.md "Device counters"): every
+counter name a ``Recorder.count(...)`` site can bump is registered in
+``obs.counter_registry()`` with a one-line meaning, so the counter
+tables in the docs and the summarizer stay complete. This is the
+ledger-registry contract (SITPU-LEDGER) applied to the other half of
+the obs surface.
+
+Discovery covers the two shapes counter names take in this codebase:
+
+- ``rec.count("name")`` / ``obs.count("name", n)`` with a **string
+  literal** name — the overwhelmingly common case;
+- names threaded through ``*_counter``-suffixed **parameters** (the
+  shared ring builders in parallel/pipeline.py take ``hop_counter=`` /
+  ``build_counter=`` so hier can relabel the same machinery): the
+  string **default** of such a parameter and every string **literal
+  keyword argument** passed to one are counter names too.
+
+Flagged:
+
+- **C1** — a discovered counter name that is not in
+  ``obs.counter_registry()`` (register it or rename to a registered
+  one);
+- **C2** — a ``.count(x)`` whose name argument is a plain variable that
+  is NOT a ``*_counter``-suffixed parameter of the enclosing function
+  (an unanalyzable dynamic name defeats the catalog; thread it through
+  a ``*_counter`` parameter instead).
+
+The registry's reverse direction (a registry row with no live site)
+lives in the round-trip test, not here — this checker only needs the
+sources in front of it, the test sees the whole scan surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from scenery_insitu_tpu.tools.lint.core import (Diagnostic, SourceFile,
+                                                call_name, func_params,
+                                                iter_calls)
+
+CODE = "SITPU-COUNTER"
+
+_COUNTER_PARAM_SUFFIX = "_counter"
+
+
+def _counter_params(fn) -> List[str]:
+    return [p for p in func_params(fn)
+            if p.endswith(_COUNTER_PARAM_SUFFIX)]
+
+
+def _param_defaults(fn) -> List[Tuple[str, ast.expr]]:
+    """(param_name, default_expr) pairs, positional and keyword-only."""
+    a = fn.args
+    out = []
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        out.append((p.arg, d))
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            out.append((p.arg, d))
+    return out
+
+
+def _enclosing_fn_of(tree: ast.Module, node: ast.AST):
+    best = None
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if (n.lineno <= node.lineno
+                    and node.lineno <= (n.end_lineno or n.lineno)):
+                if best is None or n.lineno > best.lineno:
+                    best = n
+    return best
+
+
+def discover_counters(sources) -> Dict[str, List[str]]:
+    """Statically discovered counter names -> their sites. Three
+    sources: literal ``.count("name")`` args, string defaults of
+    ``*_counter`` parameters, and string literals passed to
+    ``*_counter=`` keywords. Held equal to ``obs.counter_registry()``
+    (both directions) by the round-trip test in tests/test_lint.py."""
+    out: Dict[str, List[str]] = {}
+
+    def add(name: str, src: SourceFile, line: int) -> None:
+        out.setdefault(name, []).append(f"{src.path}:{line}")
+
+    for src in sources:
+        for c in iter_calls(src.tree):
+            if call_name(c) == "count" and c.args:
+                a = c.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value,
+                                                              str):
+                    add(a.value, src, c.lineno)
+            for kw in c.keywords:
+                if kw.arg and kw.arg.endswith(_COUNTER_PARAM_SUFFIX) \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    add(kw.value.value, src, c.lineno)
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for p, d in _param_defaults(node):
+                    if p.endswith(_COUNTER_PARAM_SUFFIX) \
+                            and isinstance(d, ast.Constant) \
+                            and isinstance(d.value, str):
+                        add(d.value, src, d.lineno)
+    return out
+
+
+def check(sources: List[SourceFile]) -> List[Diagnostic]:
+    # imported here, not at module top: the lint package stays importable
+    # without the obs package on the path (and obs is JAX-free, so this
+    # costs nothing in CI)
+    from scenery_insitu_tpu.obs import counter_registry
+
+    registry = counter_registry()
+    diags: List[Diagnostic] = []
+    discovered = discover_counters(sources)
+    by_site: Dict[str, List[Tuple[str, int]]] = {}
+    for name, sites in discovered.items():
+        for s in sites:
+            path, _, line = s.rpartition(":")
+            by_site.setdefault(name, []).append((path, int(line)))
+    for name in sorted(discovered):
+        if name in registry:
+            continue
+        for path, line in by_site[name]:
+            diags.append(Diagnostic(
+                path, line, CODE,
+                f"counter name {name!r} is not registered in "
+                f"obs.counter_registry() — add it with a one-line "
+                f"meaning (docs/OBSERVABILITY.md)",
+                ""))
+    # C2: dynamic name arguments
+    for src in sources:
+        for c in iter_calls(src.tree):
+            if call_name(c) != "count" or not c.args:
+                continue
+            a = c.args[0]
+            if isinstance(a, ast.Constant):
+                # str literals are C1's job; non-str constants are not
+                # Recorder calls (itertools.count(1))
+                continue
+            if not isinstance(a, ast.Name):
+                continue          # attribute/expr: out of scope
+            fn = _enclosing_fn_of(src.tree, c)
+            if fn is not None and a.id in _counter_params(fn):
+                continue          # the *_counter-parameter pattern
+            diags.append(Diagnostic(
+                src.path, c.lineno, CODE,
+                f"counter name is the dynamic variable {a.id!r} — "
+                f"thread it through a '*_counter'-suffixed parameter "
+                f"(with a registered string default) so the catalog "
+                f"can see it",
+                fn.name if fn is not None else "<module>"))
+    return diags

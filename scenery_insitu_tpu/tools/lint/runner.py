@@ -10,15 +10,15 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from scenery_insitu_tpu.tools.lint import (ledger, knobs, pallas, thread,
-                                           trace)
+from scenery_insitu_tpu.tools.lint import (counters, ledger, knobs, pallas,
+                                           thread, trace)
 from scenery_insitu_tpu.tools.lint.core import (Baseline, Diagnostic,
                                                 SourceFile,
                                                 default_scan_paths,
                                                 find_repo_root,
                                                 load_sources_with_diags)
 
-CHECKERS = (ledger, thread, trace, pallas, knobs)
+CHECKERS = (ledger, counters, thread, trace, pallas, knobs)
 
 
 def default_baseline_path() -> str:

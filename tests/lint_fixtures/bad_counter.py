@@ -1,0 +1,40 @@
+# SITPU-COUNTER bad fixture: counter names the catalog cannot account
+# for. Parsed by the linter only — never imported or executed.
+import itertools
+
+
+def render(rec, data):
+    # C1: literal name that is not in obs.counter_registry()
+    rec.count("frames_rendered_totally_unregistered")
+    return data
+
+
+def exchange(rec, hops, metric):
+    # C2: dynamic name that is not a *_counter-suffixed parameter of
+    # the enclosing function — the catalog cannot see it
+    rec.count(metric, hops)
+    return hops
+
+
+def build(rec, steps, step_counter="fixture_unregistered_steps"):
+    # C1 via the *_counter-parameter default: the default string is a
+    # counter name and it is not registered
+    rec.count(step_counter, steps)
+    return steps
+
+
+def relabel(rec, hops):
+    # C1 via a *_counter keyword literal: relabels the shared machinery
+    # onto an unregistered name
+    return exchange_ring(rec, hops, hop_counter="fixture_unregistered_hops")
+
+
+def fine(rec):
+    # non-Recorder count() calls are out of scope
+    seq = itertools.count(1)
+    return next(seq)
+
+
+def exchange_ring(rec, hops, hop_counter="ring_steps_built"):
+    rec.count(hop_counter, hops)
+    return hops

@@ -13,6 +13,7 @@ import os
 
 import pytest
 
+from scenery_insitu_tpu.tools.lint import counters as C
 from scenery_insitu_tpu.tools.lint import ledger as L
 from scenery_insitu_tpu.tools.lint import pallas as P
 from scenery_insitu_tpu.tools.lint import thread as TH
@@ -69,6 +70,41 @@ class TestLedger:
         comps = L.discover_degrade_components(srcs)
         assert set(comps) == {"fixture.codec", "fixture.backend",
                               "fixture.turbo"}
+
+
+# ----------------------------------------------------------- SITPU-COUNTER
+
+class TestCounter:
+    def test_bad_flagged(self):
+        diags = C.check(fixture_sources("bad_counter.py"))
+        msgs = [d.message for d in diags]
+        # unregistered literal, unregistered *_counter default,
+        # unregistered *_counter keyword, dynamic non-parameter name
+        assert len(diags) == 4, [d.render() for d in diags]
+        assert sum("not registered" in m for m in msgs) == 3
+        assert any("'frames_rendered_totally_unregistered'" in m
+                   for m in msgs)
+        assert any("'fixture_unregistered_steps'" in m for m in msgs)
+        assert any("'fixture_unregistered_hops'" in m for m in msgs)
+        assert any("dynamic variable 'metric'" in m for m in msgs)
+
+    def test_good_clean(self):
+        # run_checks applies the inline-suppression filter, silencing
+        # the one deliberately-suppressed dynamic name
+        diags = run_checks(fixture_sources("good_counter.py"))
+        assert diags == [], [d.render() for d in diags]
+
+    def test_counter_param_pattern_accepted(self):
+        # the raw checker only flags the suppressed dynamic call — the
+        # *_counter-parameter call and registered literals are clean
+        raw = C.check(fixture_sources("good_counter.py"))
+        assert [d.symbol for d in raw] == ["suppressed"]
+
+    def test_discovery(self):
+        srcs = fixture_sources("good_counter.py")
+        disc = C.discover_counters(srcs)
+        assert set(disc) == {"frame_scan_builds", "ring_steps_built",
+                             "dcn_hops_built"}
 
 
 # ------------------------------------------------------------ SITPU-THREAD
@@ -320,6 +356,30 @@ class TestLedgerRoundTrip:
         from scenery_insitu_tpu import obs
 
         reg = obs.ledger_registry()
+        assert all(isinstance(v, str) and len(v) > 10
+                   for v in reg.values())
+
+    def test_counter_registry_matches_static_scan(self):
+        """Counter twin of the degrade round-trip: every statically
+        discovered counter name is registered in obs.counter_registry()
+        and every registry row has a live count() site."""
+        from scenery_insitu_tpu import obs
+        from scenery_insitu_tpu.tools.lint.core import default_scan_paths
+
+        srcs = load_sources(ROOT, default_scan_paths(ROOT))
+        discovered = C.discover_counters(srcs)
+        registry = obs.counter_registry()
+        assert set(discovered) - set(registry) == set(), \
+            f"count() sites missing from obs.counter_registry(): " \
+            f"{ {c: discovered[c] for c in set(discovered) - set(registry)} }"
+        assert set(registry) - set(discovered) == set(), \
+            f"registry rows with no count() site: " \
+            f"{sorted(set(registry) - set(discovered))}"
+
+    def test_counter_registry_descriptions(self):
+        from scenery_insitu_tpu import obs
+
+        reg = obs.counter_registry()
         assert all(isinstance(v, str) and len(v) > 10
                    for v in reg.values())
 

@@ -290,3 +290,285 @@ def test_obs_config_roundtrip():
     assert d["obs"]["enabled"] is True
     cfg2 = FrameworkConfig.from_dict(d)
     assert cfg2.obs == cfg.obs
+
+
+# ---------------------------------------------------------------- SLO engine
+
+def _slo_cfg(**kw):
+    from scenery_insitu_tpu.config import SLOConfig
+    kw.setdefault("window", 8)
+    kw.setdefault("min_samples", 2)
+    return SLOConfig(enabled=True, **kw)
+
+
+def test_slo_disabled_noop():
+    from scenery_insitu_tpu.config import SLOConfig
+    from scenery_insitu_tpu.obs.slo import SLOEngine
+
+    rec = Recorder(enabled=True)
+    slo = SLOEngine(SLOConfig(enabled=False, frame_p99_ms=0.001), rec)
+    for i in range(50):
+        slo.observe("frame_ms", 1e9, frame=i)
+    snap = slo.snapshot()
+    assert snap["enabled"] is False
+    assert snap["metrics"] == {}
+    assert snap["healthy"] is True
+    assert rec.counters.get("slo_breaches") is None
+
+
+def test_slo_breach_fires_on_transition_and_rearms():
+    from scenery_insitu_tpu.obs.slo import SLOEngine
+
+    rec = Recorder(enabled=True)
+    slo = SLOEngine(_slo_cfg(frame_p99_ms=10.0), rec)
+    for i in range(8):                     # comfortably under budget
+        slo.observe("frame_ms", 1.0, frame=i)
+    assert not slo.breached("frame_ms")
+    for i in range(4):                     # p99 over budget: ONE episode
+        slo.observe("frame_ms", 100.0, frame=8 + i)
+    assert slo.breached("frame_ms")
+    assert rec.counters.get("slo_breaches") == 1
+    events = [e for e in rec.events if e["name"] == "slo_breach"]
+    assert len(events) == 1
+    assert events[0]["attrs"]["metric"] == "frame_ms"
+    assert events[0]["attrs"]["budget"] == 10.0
+    assert [e["component"] for e in obs.ledger()].count("slo.breach") == 1
+    # flush the window back under budget -> the gate re-arms ...
+    for i in range(8):
+        slo.observe("frame_ms", 1.0, frame=12 + i)
+    assert not slo.breached("frame_ms")
+    # ... and the next excursion is a SECOND counted episode
+    for i in range(4):
+        slo.observe("frame_ms", 100.0, frame=20 + i)
+    assert rec.counters.get("slo_breaches") == 2
+    assert slo.snapshot()["metrics"]["frame_ms"]["breaches"] == 2
+
+
+def test_slo_min_samples_gates_the_check():
+    from scenery_insitu_tpu.obs.slo import SLOEngine
+
+    rec = Recorder(enabled=True)
+    slo = SLOEngine(_slo_cfg(min_samples=5, frame_p99_ms=1.0), rec)
+    for i in range(4):                     # wildly over budget, too few
+        slo.observe("frame_ms", 1e6, frame=i)
+    assert not slo.breached()
+    slo.observe("frame_ms", 1e6, frame=4)  # 5th sample arms the gate
+    assert slo.breached("frame_ms")
+
+
+def test_slo_untracked_metric_is_gate_free():
+    from scenery_insitu_tpu.obs.slo import SLOEngine
+
+    slo = SLOEngine(_slo_cfg(), Recorder(enabled=True))
+    for i in range(20):
+        slo.observe("made_up_metric", 1e9, frame=i)
+    m = slo.snapshot()["metrics"]["made_up_metric"]
+    assert m["budget"] == 0.0 and m["breaches"] == 0
+    assert slo.snapshot()["healthy"] is True
+
+
+def test_slo_observe_phase_and_quantiles():
+    from scenery_insitu_tpu.obs.slo import SLOEngine
+
+    slo = SLOEngine(_slo_cfg(phase_p99_ms=1e9), Recorder(enabled=True))
+    for ms in (1.0, 2.0, 3.0, 4.0):
+        slo.observe_phase("composite", ms / 1e3)   # seconds, like Timers
+    m = slo.snapshot()["metrics"]["phase:composite_ms"]
+    assert m["n"] == 4 and m["last"] == 4.0
+    assert slo.quantile("phase:composite_ms", 0.50) == 2.0
+    assert slo.quantile("phase:composite_ms", 0.99) == 4.0
+
+
+def test_slo_snapshot_schema():
+    from scenery_insitu_tpu.obs.slo import SLOEngine
+
+    slo = SLOEngine(_slo_cfg(frame_p99_ms=5.0), Recorder(enabled=True))
+    slo.observe("frame_ms", 2.0, frame=0)
+    snap = slo.snapshot()
+    assert snap["type"] == "slo_report"
+    assert set(snap) == {"type", "enabled", "window", "min_samples",
+                         "metrics", "total_breaches", "healthy"}
+    assert set(snap["metrics"]["frame_ms"]) == {
+        "n", "window_n", "last", "p50", "p99", "budget", "breached",
+        "breaches"}
+    json.dumps(snap)                       # machine-readable for real
+
+
+# ------------------------------------------------- fleet telemetry collector
+
+def test_lineage_instants_and_age():
+    from scenery_insitu_tpu.obs.collector import lineage, trace_ctx
+
+    rec = Recorder(enabled=True)
+    obs.set_recorder(rec)
+    lineage("publish", "send", 3)
+    ctx = trace_ctx(3, src=1)
+    lineage("publish", "recv", None, ctx=ctx)
+    send, recv = [e for e in rec.events if e["name"] == "lineage"]
+    assert send["attrs"]["stage"] == "publish"
+    assert send["attrs"]["role"] == "send" and send["frame"] == 3
+    # the recv side decodes the wire trace context: frame comes from the
+    # ctx, and the origin stamp yields the measured age
+    assert recv["frame"] == 3 and recv["attrs"]["src"] == 1
+    assert recv["attrs"]["t_origin"] == ctx["t"]
+    assert recv["attrs"]["age_ms"] >= 0.0
+
+
+def test_publisher_collector_roundtrip():
+    zmq = pytest.importorskip("zmq")  # noqa: F841
+    from scenery_insitu_tpu.obs.collector import Collector, ObsPublisher
+
+    col = Collector()
+    pub = ObsPublisher(col.endpoint, col.hb_endpoint, rank=2,
+                       interval_s=0.0)
+    try:
+        # prove the PUB path first (the channel is legally lossy while
+        # the zmq subscription handshake is in flight)
+        deadline = __import__("time").monotonic() + 10.0
+        while not pub.linked and __import__("time").monotonic() < deadline:
+            pub.probe()
+            col.poll(10)
+        assert pub.linked
+        assert col.batches == 0            # probes carry no payload
+        rec = Recorder(enabled=True, rank=2)
+        with rec.span("frame", frame=0):
+            pass
+        assert pub.pump(rec, force=True)
+        for _ in range(100):
+            if col.poll(20):
+                break
+        assert col.batches == 1
+        merged = col.merged_events()
+        assert any(e["name"] == "frame" and e["rank"] == 2
+                   for e in merged)
+        # the pong-driven clock model has a sane bound on loopback
+        assert pub.rtt > 0.0
+        assert abs(pub.clock_offset) < 5.0
+    finally:
+        pub.close()
+        col.close()
+
+
+def test_publisher_to_dead_collector_drops_are_ledgered():
+    pytest.importorskip("zmq")
+    from scenery_insitu_tpu.obs.collector import Collector, ObsPublisher
+
+    col = Collector()
+    ep, hb = col.endpoint, col.hb_endpoint
+    col.close()                            # collector is GONE
+    pub = ObsPublisher(ep, hb, rank=0, interval_s=0.0)
+    rec = Recorder(enabled=True)
+    try:
+        for i in range(5):
+            with rec.span("frame", frame=i):
+                pass
+            pub.pump(rec, force=True)      # never raises, never blocks
+        # a PUB socket discards silently, so the verdict comes from the
+        # heartbeat liveness: >= 3 unanswered pings = presumed lost
+        assert pub.drops > 0
+        assert rec.counters.get("obs_batch_drops", 0) > 0
+        assert any(e["component"] == "obs.collector"
+                   for e in obs.ledger())
+    finally:
+        pub.close()
+
+
+# ---------------------------------------------------------- flight recorder
+
+def test_flight_recorder_dumps_partial_artifacts_on_crash(tmp_path):
+    """Kill the session mid-run (sim raises at frame 2 of 5): the crash
+    path must flush WELL-FORMED partial trace/metrics artifacts before
+    the exception propagates — the window that explains the crash is
+    exactly the one a normal flush would have lost."""
+    from scenery_insitu_tpu.runtime.session import VolumeSimAdapter
+
+    class DyingSim:
+        def __init__(self, inner, die_at):
+            self._inner = inner
+            self._die_at = die_at
+            self._calls = 0
+            self.kind = inner.kind
+
+        def advance(self, n):
+            if self._calls >= self._die_at:
+                raise RuntimeError("sim exploded mid-run")
+            self._calls += 1
+            self._inner.advance(n)
+
+        @property
+        def field(self):
+            return self._inner.field
+
+    trace = tmp_path / "trace.json"
+    metrics = tmp_path / "metrics.jsonl"
+    cfg = _session_cfg(**{"obs.enabled": "true",
+                          "obs.trace_path": str(trace),
+                          "obs.metrics_path": str(metrics)})
+    sess = InSituSession(cfg, mesh=make_mesh(2),
+                         sim=DyingSim(VolumeSimAdapter(cfg), die_at=2))
+    with pytest.raises(RuntimeError, match="sim exploded"):
+        sess.run(5)
+    # both artifacts exist, parse, and hold the pre-crash frames (the
+    # dying frame's sim span still closes, so it may be the last one)
+    doc = json.load(open(trace))
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    frames = {e["args"].get("frame") for e in xs if e["name"] == "sim"}
+    assert {0, 1} <= frames and max(frames) <= 2
+    lines = [json.loads(l) for l in open(metrics) if l.strip()]
+    assert lines[-1]["type"] == "summary"
+    assert sess.obs.counters.get("flight_dumps") == 1
+    assert any(e["name"] == "flight_dump" for e in sess.obs.events)
+    assert any(e["component"] == "obs.flight_recorder"
+               for e in obs.ledger())
+
+
+# ------------------------------------------- session x fleet side-channel
+
+def test_session_pumps_configured_collector(tmp_path):
+    pytest.importorskip("zmq")
+    from scenery_insitu_tpu.obs.collector import Collector
+
+    col = Collector()
+    try:
+        cfg = _session_cfg(**{
+            "obs.enabled": "true",
+            "obs.collector": col.endpoint,
+            "obs.collector_hb": col.hb_endpoint,
+            "obs.collector_interval_s": 0.001})
+        sess = InSituSession(cfg, mesh=make_mesh(2))
+        # settle the PUB path before the frames (the channel is legally
+        # lossy during the zmq subscription handshake)
+        deadline = __import__("time").monotonic() + 10.0
+        while (not sess._obs_pub.linked
+               and __import__("time").monotonic() < deadline):
+            sess._obs_pub.probe()
+            col.poll(10)
+        assert sess._obs_pub.linked
+        sess.run(3)
+        for _ in range(100):
+            col.poll(20)
+            if col.batches > 0 and any(
+                    e["name"] == "sim" for e in col.merged_events()):
+                break
+        assert col.batches > 0
+        names = {e["name"] for e in col.merged_events()}
+        assert "sim" in names              # real session phases arrived
+        assert sess.obs.counters.get("obs_batches_published", 0) > 0
+    finally:
+        col.close()
+
+
+def test_session_slo_breach_end_to_end():
+    # min_samples first: overrides validate one at a time, and the
+    # default min_samples (16) would not fit the shrunken window
+    cfg = _session_cfg(**{"slo.enabled": "true", "slo.min_samples": "1",
+                          "slo.window": "8",
+                          "slo.frame_p99_ms": "0.000001"})
+    sess = InSituSession(cfg, mesh=make_mesh(2))
+    sess.run(2)                            # any real frame breaches that
+    snap = sess.slo.snapshot()
+    assert snap["total_breaches"] >= 1
+    assert snap["metrics"]["frame_ms"]["n"] == 2
+    assert not snap["healthy"]
+    assert sess.obs.counters.get("slo_breaches", 0) >= 1
+    assert any(e["component"] == "slo.breach" for e in obs.ledger())

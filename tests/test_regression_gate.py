@@ -1,0 +1,129 @@
+"""Regression sentinel (benchmarks/regression_gate.py): the committed
+artifacts must self-check clean, a synthetic perturbation must be
+flagged, and the degrade paths (unknown schema, missing baseline) must
+land in the ledger instead of failing the world. No jax needed."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import regression_gate as rg  # noqa: E402
+
+from scenery_insitu_tpu import obs  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    obs.clear_ledger()
+    yield
+    obs.clear_ledger()
+
+
+def _write(d, name, doc):
+    # fresh artifacts go OUTSIDE the results dir — committed_baseline
+    # scans every *.json there, and a fresh file inside would become its
+    # own (lexicographically newest) baseline
+    path = os.path.join(str(d), name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _hier(value):
+    return {"metric": "hier_weak_scaling_test", "value": value}
+
+
+# ------------------------------------------------------- committed truth
+
+def test_self_check_committed_baselines_pass():
+    """The acceptance half the CI lane runs: every committed artifact of
+    a known family still clears its floors."""
+    failures, report = rg.self_check()
+    assert failures == [], failures
+    assert report["ok"] and report["families"]
+    # the families the repo has actually landed artifacts for
+    assert {"lod_ladder", "delta_ab", "hier_weak_scaling",
+            "serve_bench", "scenario_bench"} <= set(report["families"])
+
+
+def test_main_self_check_exit_code():
+    assert rg.main(["--json"]) == 0
+
+
+# --------------------------------------------------- synthetic regression
+
+def test_synthetic_perturbation_is_flagged(tmp_path):
+    """The other acceptance half: perturb a gated key beyond its noise
+    band in the worse direction and the gate must fail."""
+    _write(tmp_path, "base_r1.json", _hier(2.0))
+    # a 40% drop blows through the 35% NOISY band
+    fresh = _write(tmp_path / "out", "fresh.json", _hier(1.2))
+    failures, report = rg.check_fresh(fresh, results_dir=str(tmp_path))
+    assert any("regressed" in f for f in failures), failures
+    assert report["family"] == "hier_weak_scaling"
+    assert rg.main(["--fresh", fresh, "--results-dir", str(tmp_path)]) == 1
+
+
+def test_within_band_move_passes(tmp_path):
+    _write(tmp_path, "base_r1.json", _hier(2.0))
+    fresh = _write(tmp_path / "out", "fresh.json", _hier(1.9))  # 5% move
+    failures, _ = rg.check_fresh(fresh, results_dir=str(tmp_path))
+    assert failures == []
+
+
+def test_floor_violation_flagged_even_vs_matching_baseline(tmp_path):
+    """A floor is absolute: a baseline that is itself under the floor
+    does not grandfather the fresh artifact in."""
+    _write(tmp_path, "base_r1.json", _hier(0.5))
+    fresh = _write(tmp_path / "out", "fresh.json", _hier(0.5))   # floor is 0.7
+    failures, _ = rg.check_fresh(fresh, results_dir=str(tmp_path))
+    assert any("floor" in f for f in failures), failures
+
+
+def test_key_vanishing_from_fresh_artifact_flagged(tmp_path):
+    """A fresh artifact that silently stops reporting a gated key is a
+    regression, not a pass."""
+    _write(tmp_path, "base_r1.json", {
+        "kind": "delta_ab",
+        "scenes": {"slab": {"wire": {"bytes_ratio": 0.5}}}})
+    fresh = _write(tmp_path / "out", "fresh.json",
+                   {"kind": "delta_ab", "scenes": {}})
+    failures, _ = rg.check_fresh(fresh, results_dir=str(tmp_path))
+    assert any("missing from fresh" in f for f in failures), failures
+
+
+# ------------------------------------------------------- degrade ledger
+
+def test_unknown_schema_is_skipped_and_ledgered(tmp_path):
+    fresh = _write(tmp_path / "out", "fresh.json", {"hello": "world"})
+    failures, report = rg.check_fresh(fresh, results_dir=str(tmp_path))
+    assert failures == [] and report["family"] is None
+    assert any(e["component"] == "regression.artifact"
+               for e in obs.ledger()), obs.ledger()
+
+
+def test_missing_baseline_degrades_to_record_only(tmp_path):
+    fresh = _write(tmp_path / "out", "fresh.json", _hier(0.9))
+    failures, report = rg.check_fresh(fresh, results_dir=str(tmp_path))
+    assert failures == [] and report["baseline"] is None
+    assert any(e["component"] == "regression.baseline"
+               for e in obs.ledger()), obs.ledger()
+
+
+def test_trajectory_row_recorded(tmp_path):
+    _write(tmp_path, "base_r1.json", _hier(2.0))
+    fresh = _write(tmp_path / "out", "fresh.json", _hier(1.95))
+    assert rg.main(["--fresh", fresh, "--record",
+                    "--results-dir", str(tmp_path)]) == 0
+    rows = [json.loads(ln) for ln in
+            open(tmp_path / "trajectory.jsonl")]
+    assert rows and rows[-1]["type"] == "trajectory"
+    assert rows[-1]["family"] == "hier_weak_scaling"
+    assert rows[-1]["keys"] == {"weak_efficiency": 1.95}
+    assert rows[-1]["baseline"] == "base_r1.json"

@@ -245,7 +245,7 @@ def main():
     # the fused step covers generate+all_to_all+composite ONLY (sim runs
     # before it, gather after) — compare like with like
     split_render = sum(ms[k] for k in ("generate", "all_to_all", "composite"))
-    from scenery_insitu_tpu.obs.device import cost_snapshot
+    from scenery_insitu_tpu.obs.device import device_cost
 
     print(json.dumps({
         "metric": f"phase_breakdown_{n}ranks_{g}c",
@@ -261,7 +261,7 @@ def main():
         "obs_overhead": obs_ab,
         # device-cost truth + everything that did not run as configured
         # (same record shape bench.py embeds — see docs/OBSERVABILITY.md)
-        "cost_analysis": {"fused_step": cost_snapshot(
+        "cost_analysis": {"fused_step": device_cost(
             fused, v, origin, spacing, cam)},
         "degradations": obs.ledger(),
         "backend": jax.default_backend(),

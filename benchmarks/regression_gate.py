@@ -173,6 +173,23 @@ def _x_waves(doc: dict) -> Dict[str, Gate]:
     return out
 
 
+def _x_divergence(doc: dict) -> Dict[str, Gate]:
+    """The attribution plane's divergence report (ISSUE 18): gate the
+    model's stated blind spot and each lever's share divergence. All
+    shares are wall-clock-derived on shared runners → NOISY band; the
+    floors only catch a capture whose attribution collapsed entirely
+    (unmodeled_share ~1.0 means the scopes joined nothing new)."""
+    out = {}
+    if doc.get("unmodeled_share") is not None:
+        out["unmodeled_share"] = Gate(doc["unmodeled_share"], "lower",
+                                      NOISY, floor=0.99)
+    for lever, e in sorted((doc.get("levers") or {}).items()):
+        if e.get("share_delta") is not None:
+            out[f"{lever}.abs_share_delta"] = Gate(
+                abs(e["share_delta"]), "lower", NOISY)
+    return out
+
+
 # (family name, matcher over the parsed doc, extractor)
 FAMILIES: Tuple[Tuple[str, object, object], ...] = (
     ("lod_ladder",
@@ -192,6 +209,8 @@ FAMILIES: Tuple[Tuple[str, object, object], ...] = (
      _x_scenario),
     ("composite_ab",
      lambda d: isinstance(d.get("exchange"), dict), _x_waves),
+    ("divergence_report",
+     lambda d: d.get("type") == "divergence_report", _x_divergence),
 )
 
 

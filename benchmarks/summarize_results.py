@@ -36,7 +36,43 @@ def rows_of(path: str):
     return out
 
 
+def _fmt_attribution(a: dict, head: str = "phase_attribution") -> list:
+    """Lines for one phase_attribution record (bare or embedded in a
+    bench artifact — the attribution plane, docs/OBSERVABILITY.md)."""
+    lines = [f"{head}: [{a.get('backend', '?')}] "
+             f"{a.get('wall_ms_per_frame')} ms/frame wall, coverage "
+             f"{a.get('coverage')}"
+             + (f" (op_parallelism {a.get('op_parallelism')}, "
+                f"normalized)" if a.get("normalized") else "")]
+    wall = float(a.get("wall_ms_per_frame") or 0.0)
+    phs = sorted((a.get("phases") or {}).items(),
+                 key=lambda kv: -float(kv[1].get("ms") or 0.0))
+    for name, p in phs:
+        ms = float(p.get("ms") or 0.0)
+        share = f" ({ms / wall:5.1%})" if wall > 0 else ""
+        lines.append(f"  {name:14s} {ms:10.2f} ms{share} "
+                     f"events={p.get('events')}")
+    return lines
+
+
 def fmt(r: dict) -> str:
+    if r.get("type") == "phase_attribution":     # bare attribution capture
+        return "\n   ".join(_fmt_attribution(r))
+    if r.get("type") == "divergence_report":     # model-vs-measured deltas
+        lines = [f"divergence_report: vs {r.get('modeled_row')} "
+                 f"[{r.get('modeled_artifact')}] unmodeled_share="
+                 f"{r.get('unmodeled_share')}"]
+        for lv, e in sorted((r.get("levers") or {}).items()):
+            lines.append(
+                f"  {lv:18s} modeled={e.get('modeled_ms')} measured="
+                f"{e.get('measured_ms')} ms  share "
+                f"{e.get('modeled_share')} -> {e.get('measured_share')} "
+                f"(d={e.get('share_delta')}, bound={e.get('bound')})")
+        for row in (r.get("next_perf_pr") or [])[:3]:
+            lines.append(f"  next: {row.get('lever')} "
+                         f"d_share={row.get('share_delta')} — "
+                         f"{row.get('verdict')}")
+        return "\n   ".join(lines)
     if r.get("type") == "slo_report":            # live SLO engine snapshot
         lines = [f"slo_report: healthy={r.get('healthy')} "
                  f"breaches={r.get('total_breaches')} "
@@ -230,8 +266,14 @@ def fmt(r: dict) -> str:
             return f"{r['metric']}: ERROR {str(r['error'])[:60]}"
         vs = r.get("vs_baseline")
         vs_s = f"  vs_baseline={vs}" if vs is not None else ""
-        return (f"{r['metric']}: {val} {unit} [{plat}]"
+        line = (f"{r['metric']}: {val} {unit} [{plat}]"
                 f"{extra}{vs_s}")
+        if isinstance(r.get("phase_attribution"), dict):
+            # bench artifact with the attribution plane riding along
+            return "\n   ".join(
+                [line] + _fmt_attribution(r["phase_attribution"],
+                                          head="attribution"))
+        return line
     return json.dumps(r)[:100]
 
 

@@ -14,6 +14,13 @@
 #   SITPU_WATCHER_DEADLINE=<epoch> hard stop (default: +6h from launch)
 #   SITPU_WATCHER_POLLS=900        probe attempts before giving up
 #   SITPU_WATCHER_SLEEP=45         seconds between dead-tunnel probes
+#   SITPU_WATCHER_PROFILE=1        attribution plane on EVERY bench step
+#                                  (exports SITPU_BENCH_PROFILE=1, so
+#                                  each artifact embeds the per-phase
+#                                  attribution + roofline verdicts +
+#                                  divergence report); step 18 captures
+#                                  the dedicated profiled flagship
+#                                  either way
 #
 # Any SITPU_BENCH_* in the environment passes through to every step, so
 # one-off knob sweeps don't need to edit the queue. The companion
@@ -31,6 +38,11 @@ LAYOUT=${ROUND}v1
 if [ "$(cat /tmp/watcher_layout 2>/dev/null)" != "$LAYOUT" ]; then
   rm -f /tmp/watcher_fail.*
   echo "$LAYOUT" > /tmp/watcher_layout
+fi
+# attribution plane on every bench step (docs/OBSERVABILITY.md):
+# SITPU_BENCH_* passes through to each step, so one export suffices
+if [ "${SITPU_WATCHER_PROFILE:-0}" = "1" ]; then
+  export SITPU_BENCH_PROFILE=1
 fi
 
 probe() {
@@ -177,6 +189,20 @@ run_step() {
          python benchmarks/lod_bench.py --grid 2048 --iters 1 \
          --max-level 3 --ladder 4.0 8.0 16.0 --k 8 \
          --out "$R/lod_2048_tpu_${ROUND}.json" ;;
+    # attribution plane on the flagship (ISSUE 18; the committed CPU
+    # capture is attribution_r18_cpu): traced frames joined to the
+    # sitpu_* phase scopes + roofline verdicts + divergence report vs
+    # the committed modeled projection, then the standalone report file
+    18) run_json "$R/attribution_tpu_${ROUND}.json" 1200 env \
+         SITPU_BENCH_AUTOTUNE=0 SITPU_BENCH_PROFILE=1 \
+         SITPU_BENCH_PLATFORMS=tpu SITPU_BENCH_CHILD_TIMEOUT=900 \
+         python bench.py
+       if [ -e "$R/attribution_tpu_${ROUND}.json" ]; then
+         timeout 120 python benchmarks/divergence.py \
+           --attribution "$R/attribution_tpu_${ROUND}.json" \
+           --out "$R/divergence_tpu_${ROUND}.json" 2>>"$L" \
+           && echo "ok: $R/divergence_tpu_${ROUND}.json" >> "$L"
+       fi ;;
   esac
 }
 
@@ -199,10 +225,11 @@ step_out() {
     15) echo "$R/bricks_ab_tpu_${ROUND}.json" ;;
     16) echo "$R/lod_ab_tpu_${ROUND}.json" ;;
     17) echo "$R/lod_2048_tpu_${ROUND}.json" ;;
+    18) echo "$R/attribution_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=17
+NSTEPS=18
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

@@ -14,6 +14,7 @@ from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
 from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.transfer import TransferFunction, for_dataset
 from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.obs.profiler import phase as _phase
 from scenery_insitu_tpu.ops.composite import composite_vdis
 from scenery_insitu_tpu.ops.splat import speed_colors, splat_particles
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
@@ -161,10 +162,13 @@ def grayscott_vdi_frame_step(width: int, height: int,
             # the occupancy structure rides out of the sim advance
             # (fused-kernel epilogue, lax fallback ledgered) — the
             # render below never re-reads the volume for it
-            state, rng = gs.multi_step_fast_ranges(
-                gs.GrayScott(u, v, params), sim_steps, fused=sim_fused)
+            with _phase("sim_step"):
+                state, rng = gs.multi_step_fast_ranges(
+                    gs.GrayScott(u, v, params), sim_steps,
+                    fused=sim_fused)
         else:
-            state = advance(gs.GrayScott(u, v, params), sim_steps)
+            with _phase("sim_step"):
+                state = advance(gs.GrayScott(u, v, params), sim_steps)
         field = state.field if rdt is None else state.field.astype(rdt)
         vol = Volume.centered(field, extent=2.0)
         occ_pyr = None
@@ -173,17 +177,20 @@ def grayscott_vdi_frame_step(width: int, height: int,
 
             occ_pyr = occ_mod.pyramid_from_ranges(rng, vol, tf, spec)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
-        if temporal:
-            vdi, _, _, thr = slicer.generate_vdi_mxu_temporal(
-                vol, tf, cam, spec, thr, vdi_cfg, occupancy=occ_pyr)
-        elif engine == "mxu":
-            vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec,
-                                                vdi_cfg,
-                                                occupancy=occ_pyr)
-        else:
-            vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
-                                  max_steps=max_steps)
-        out = composite_vdis(vdi.color[None], vdi.depth[None], comp_cfg)
+        with _phase("march"):
+            if temporal:
+                vdi, _, _, thr = slicer.generate_vdi_mxu_temporal(
+                    vol, tf, cam, spec, thr, vdi_cfg, occupancy=occ_pyr)
+            elif engine == "mxu":
+                vdi, _, _ = slicer.generate_vdi_mxu(vol, tf, cam, spec,
+                                                    vdi_cfg,
+                                                    occupancy=occ_pyr)
+            else:
+                vdi, _ = generate_vdi(vol, tf, cam, width, height,
+                                      vdi_cfg, max_steps=max_steps)
+        with _phase("merge"):
+            out = composite_vdis(vdi.color[None], vdi.depth[None],
+                                 comp_cfg)
         if temporal:
             return out.color, out.depth, state.u, state.v, thr
         return out.color, out.depth, state.u, state.v
@@ -241,18 +248,24 @@ def hybrid_vortex_frame_step(width: int, height: int,
             pos = vortex.advect_tracers(fl.u, pos, params.dt)
             return vortex.step(fl), pos
 
-        flow, tracer_pos2 = jax.lax.fori_loop(0, sim_steps, advance,
-                                              (flow, tracer_pos))
+        with _phase("sim_step"):
+            flow, tracer_pos2 = jax.lax.fori_loop(0, sim_steps, advance,
+                                                  (flow, tracer_pos))
         vol = Volume.centered(flow.field, extent=2.0)
         cam = Camera.create(eye, fov_y_deg=fov_y_deg, near=0.5, far=20.0)
-        vdi, _, axcam = slicer.generate_vdi_mxu(vol, tf, cam, spec, vdi_cfg)
+        with _phase("march"):
+            vdi, _, axcam = slicer.generate_vdi_mxu(vol, tf, cam, spec,
+                                                    vdi_cfg)
 
         vel = vortex.tracer_velocities(flow.u, tracer_pos2)
         rgba = speed_colors(vel, colormap)
         world = vortex.tracers_to_world(tracer_pos2, vol.origin, vol.spacing)
-        sp = splat_particles(world, rgba, radius, None, spec.ni, spec.nj,
-                             stamp, view=axcam.view, proj=axcam.proj)
-        inter = composite_vdi_with_particles(vdi, sp)
+        with _phase("march"):
+            sp = splat_particles(world, rgba, radius, None, spec.ni,
+                                 spec.nj, stamp, view=axcam.view,
+                                 proj=axcam.proj)
+        with _phase("merge"):
+            inter = composite_vdi_with_particles(vdi, sp)
         img = slicer.warp_to_camera(inter, axcam, spec, cam, width, height,
                                     background)
         return img, flow.u, tracer_pos2
@@ -276,7 +289,8 @@ def lj_particle_frame_step(width: int, height: int,
 
     def frame_step(pos, vel, box, eye):
         state = pt.ParticleState(pos, vel, box)
-        state = pt.lj_multi_step(state, params, spec, sim_steps)
+        with _phase("sim_step"):
+            state = pt.lj_multi_step(state, params, spec, sim_steps)
         cam = Camera.create(eye, target=(0.0, 0.0, 0.0),
                             fov_y_deg=fov_y_deg, near=0.5, far=100.0)
         # center the box on the origin for viewing

@@ -41,3 +41,26 @@ def cost_snapshot(jitted, *args) -> Optional[Dict[str, Any]]:
     except Exception as e:                     # noqa: BLE001 — best-effort
         return {"source": "unavailable",
                 "error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+
+def device_cost(jitted, *args) -> Dict[str, Any]:
+    """The ONE cost-analysis join every consumer reads (bench.py,
+    benchmarks/phase_bench.py, obs/roofline.py, the divergence engine):
+    ``cost_snapshot`` normalized to an always-a-dict record with
+    identical keys everywhere, and the unavailable case minted through
+    the ``bench.cost_analysis`` degrade component so artifacts carry WHY
+    the bytes/flops are missing.
+
+    Returns ``{"source": "xla_cost_analysis", "bytes_accessed": ...,
+    "flops": ..., ...}`` on success; ``{"source": "unavailable",
+    "error": ...}`` (degrade minted) otherwise."""
+    from scenery_insitu_tpu.obs.recorder import degrade
+
+    snap = cost_snapshot(jitted, *args)
+    if snap is None or "bytes_accessed" not in snap:
+        err = (snap or {}).get("error", "no cost analysis")
+        degrade("bench.cost_analysis", "xla_cost_analysis",
+                "traffic_model",
+                f"backend reported no cost analysis ({err})", warn=False)
+        return {"source": "unavailable", "error": err}
+    return snap

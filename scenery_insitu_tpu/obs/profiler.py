@@ -1,0 +1,311 @@
+"""Phase attribution inside compiled steps.
+
+The distributed frame is ONE jitted SPMD program by design (XLA overlaps
+march, exchange and composite), so host-side spans can only see
+dispatch+fetch — the march/exchange/merge split inside the step is
+invisible to every timer the repo has. This module makes the device
+explain itself:
+
+1. Every step builder in ``parallel/pipeline.py`` (plus hier.py,
+   ops/composite.py and models/pipelines.py) wraps its phases in
+   ``phase(name)`` — a ``jax.named_scope`` with the ``sitpu_`` prefix.
+   XLA carries the scope through fusion into per-instruction
+   ``op_name`` metadata in the compiled HLO.
+2. ``ProfileCapture`` runs N bracketed frames under
+   ``jax.profiler.trace``, parses the emitted trace-event JSON
+   (``plugins/profile/<ts>/*.trace.json.gz``), and joins each XLA op
+   event back to its scope via the compiled HLO text: instruction names
+   are module-unique and the trace events carry ``args.hlo_op`` +
+   ``args.hlo_module``. This join is backend-portable — it works on the
+   CPU trace backend today and on TPU XSpace-derived traces unchanged.
+
+Accounting (validated against an 8-device virtual-mesh probe):
+
+- events are NOT duplicated per pooled runtime thread — one event per
+  (op, device, frame) — so per-phase ms = Σ dur / (frames × devices);
+- scan-body ops legitimately recur per iteration, which total-sum
+  accounting handles for free;
+- the innermost (**last**) ``sitpu_`` component of an op_name wins, so
+  an outer ``sitpu_wave`` scope never subsumes the march/exchange
+  scopes nested inside it;
+- device time the scopes don't explain lands in ``unattributed``; the
+  gap between wall-clock and total device time lands in ``host`` (one
+  of the roofline bound classes); when an intra-op thread pool makes
+  summed op time EXCEED wall (CPU backends), the breakdown is
+  normalized onto the wall (``normalized: true``, raw ratio kept in
+  ``op_parallelism``) — so the per-phase sum matches the measured step
+  wall-clock by construction and ``coverage`` records how much of the
+  wall the device actually explained.
+
+Module-level ``import jax`` is intentional: only JAX-bearing code
+(pipeline builders, bench children, tests) imports this file; the
+JAX-free artifact consumers live in obs/roofline.py and
+benchmarks/divergence.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from scenery_insitu_tpu.obs import recorder as _rec
+
+SCOPE_PREFIX = "sitpu_"
+
+# The phase catalog — every named scope the step builders emit. Tests
+# assert per-builder subsets of these appear in lowered HLO; the CI
+# attribution lane asserts the captured breakdown names come from here
+# (plus the two synthetic phases the capture itself mints).
+PHASES = ("march", "halo", "exchange", "merge", "resegment",
+          "wire_encode", "sim_step", "dcn_hop", "wave")
+
+# Synthetic phases ProfileCapture adds on top of the scope catalog.
+EXTRA_PHASES = ("unattributed", "host")
+
+
+def phase(name: str):
+    """Named scope for one step phase — ``with phase("march"): ...``.
+    Zero runtime cost inside jit (it only tags HLO metadata)."""
+    return jax.named_scope(SCOPE_PREFIX + name)
+
+
+def scope_of(op_name: str) -> Optional[str]:
+    """Extract the phase from an HLO ``op_name`` metadata path. The LAST
+    ``sitpu_`` component wins so nested scopes attribute to the
+    innermost phase (wave(march) → march)."""
+    found = None
+    for comp in op_name.split("/"):
+        if comp.startswith(SCOPE_PREFIX):
+            found = comp[len(SCOPE_PREFIX):]
+    return found
+
+
+def scope_names(text: str) -> set:
+    """All ``sitpu_*`` phase names present in an HLO / StableHLO dump —
+    works on both ``lower().as_text()`` (loc metadata) and
+    ``compile().as_text()`` (op_name metadata)."""
+    return set(re.findall(r"sitpu_(\w+)", text))
+
+
+_HLO_MODULE_RE = re.compile(r"^HloModule ([^,\s]+)", re.M)
+_HLO_OP_RE = re.compile(
+    r"%?([\w\.\-]+) = [^\n]*?metadata=\{[^}]*?op_name=\"([^\"]*)\"")
+
+
+def parse_hlo_scopes(hlo_text: str):
+    """(module_name, {instruction_name: phase}) from compiled HLO text.
+    Instruction names are module-unique, so they key the trace join."""
+    m = _HLO_MODULE_RE.search(hlo_text)
+    module = m.group(1) if m else None
+    ops: Dict[str, str] = {}
+    for inst, op_name in _HLO_OP_RE.findall(hlo_text):
+        sc = scope_of(op_name)
+        if sc is not None:
+            ops[inst] = sc
+    return module, ops
+
+
+def _trace_events(trace_dir: str):
+    """Load the newest emitted trace under ``trace_dir`` and yield its
+    complete ("X") events. jax.profiler.trace writes
+    ``<dir>/plugins/profile/<ts>/<host>.trace.json.gz`` on every
+    backend that supports tracing (CPU included)."""
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json*")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no trace emitted under {trace_dir!r} (profiler backend "
+            "absent?)")
+    newest_run = os.path.dirname(paths[-1])
+    for path in paths:
+        if os.path.dirname(path) != newest_run:
+            continue
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as f:
+            doc = json.load(f)
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "X":
+                yield ev
+
+
+class ProfileCapture:
+    """Run N traced frames of a compiled step and attribute device time
+    back to the ``sitpu_*`` phase scopes.
+
+    ``capture(fn, *args, step=None)``:
+
+    - ``fn`` must be jitted (it is lowered via ``fn.lower(*args)`` to
+      get the compiled HLO — lowering is abstract, so donated buffers
+      are fine);
+    - ``step`` optionally runs ONE frame (a zero-arg callable returning
+      something blockable). Required when ``fn`` donates its inputs and
+      the caller threads state between frames (bench.py); when omitted,
+      frames are ``fn(*args)``.
+
+    Disabled captures return None without touching the profiler, the
+    trace machinery or the step — the zero-overhead path. Failures
+    degrade through the ``obs.profiler`` ledger component and also
+    return None; they never take the caller down.
+    """
+
+    def __init__(self, frames: int = 3, enabled: bool = True,
+                 trace_dir: Optional[str] = None, warmup: int = 1,
+                 devices: Optional[int] = None):
+        self.frames = max(1, int(frames))
+        self.enabled = bool(enabled)
+        self.trace_dir = trace_dir
+        self.warmup = max(0, int(warmup))
+        self.devices = devices
+
+    def capture(self, fn, *args,
+                step: Optional[Callable[[], Any]] = None
+                ) -> Optional[Dict[str, Any]]:
+        if not self.enabled:
+            return None
+        try:
+            return self._capture(fn, args, step)
+        except Exception as e:          # noqa: BLE001 — capture is
+            # best-effort observability; the step being profiled must
+            # keep running whatever the trace backend did
+            _rec.degrade("obs.profiler", "device_trace", "none",
+                         f"profile capture failed: {e}", warn=False)
+            return None
+
+    # ------------------------------------------------------------------
+    def _capture(self, fn, args, step):
+        hlo = fn.lower(*args).compile().as_text()
+        module, op_scopes = parse_hlo_scopes(hlo)
+
+        run = step if step is not None else (
+            lambda: jax.block_until_ready(fn(*args)))
+        for _ in range(self.warmup):
+            jax.block_until_ready(run())
+
+        trace_dir = self.trace_dir or tempfile.mkdtemp(
+            prefix="sitpu_profile_")
+        t0 = time.perf_counter()
+        with jax.profiler.trace(trace_dir):
+            for _ in range(self.frames):
+                jax.block_until_ready(run())
+        wall_ms = (time.perf_counter() - t0) * 1e3 / self.frames
+
+        phase_us: Dict[str, float] = {}
+        phase_events: Dict[str, int] = {}
+        total_events = joined = 0
+        for ev in _trace_events(trace_dir):
+            ev_args = ev.get("args") or {}
+            if module is not None and ev_args.get(
+                    "hlo_module") not in (None, module):
+                continue
+            op = ev_args.get("hlo_op") or ev.get("name")
+            if op is None:
+                continue
+            total_events += 1
+            sc = op_scopes.get(op)
+            if sc is None:
+                sc = "unattributed"
+            else:
+                joined += 1
+            phase_us[sc] = phase_us.get(sc, 0.0) + float(
+                ev.get("dur") or 0.0)
+            phase_events[sc] = phase_events.get(sc, 0) + 1
+
+        devices = self.devices or jax.local_device_count()
+        phases = {
+            name: {"ms": round(us / 1e3 / (self.frames * devices), 4),
+                   "events": phase_events.get(name, 0)}
+            for name, us in sorted(phase_us.items())}
+        device_ms = sum(p["ms"] for p in phases.values())
+        # CPU runtimes execute ops across an intra-op thread pool, so
+        # summed op time can exceed wall-clock (parallelism > 1); a TPU
+        # core's timeline is serialized, so this is a no-op there. The
+        # breakdown is normalized onto the wall so the per-phase sum IS
+        # the frame time; op_parallelism keeps the raw ratio honest.
+        op_parallelism = (device_ms / wall_ms) if wall_ms > 0 else None
+        normalized = False
+        if op_parallelism is not None and op_parallelism > 1.0:
+            scale = wall_ms / device_ms
+            for p in phases.values():
+                p["ms"] = round(p["ms"] * scale, 4)
+            device_ms = sum(p["ms"] for p in phases.values())
+            normalized = True
+        host_ms = max(0.0, wall_ms - device_ms)
+        phases["host"] = {"ms": round(host_ms, 4), "events": 0}
+
+        attr = {
+            "type": "phase_attribution",
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "hlo_module": module,
+            "frames": self.frames,
+            "devices": devices,
+            "wall_ms_per_frame": round(wall_ms, 4),
+            "device_ms_per_frame": round(device_ms, 4),
+            "coverage": (round(min(1.0, op_parallelism), 4)
+                         if op_parallelism is not None else None),
+            "op_parallelism": (round(op_parallelism, 4)
+                               if op_parallelism is not None else None),
+            "normalized": normalized,
+            "scoped_ops": len(op_scopes),
+            "events_total": total_events,
+            "events_joined": joined,
+            "phases": phases,
+        }
+        _rec.get_recorder().count("profile_captures")
+        return attr
+
+
+# ------------------------------------------------- fleet-trace export
+
+def attribution_chrome_events(attr: Dict[str, Any],
+                              pid: int = 9000) -> list:
+    """Render one attribution as extra Perfetto tracks: a synthetic
+    "device phases" process whose complete events lay the per-phase ms
+    out sequentially (one representative frame). Append these to a
+    Recorder ``chrome_trace_events()`` list or an exported trace file
+    (``append_to_chrome_trace``)."""
+    out = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": "device phases (attributed)"}}]
+    ts = 0.0
+    for name, p in (attr.get("phases") or {}).items():
+        dur = float(p.get("ms") or 0.0) * 1e3    # µs
+        out.append({"ph": "X", "name": name, "pid": pid, "tid": 0,
+                    "ts": round(ts, 1), "dur": round(dur, 1),
+                    "cat": "device_phase",
+                    "args": {"ms": p.get("ms"),
+                             "events": p.get("events")}})
+        ts += dur
+    return out
+
+
+def append_to_chrome_trace(attr: Dict[str, Any], path: str) -> str:
+    """Append the attribution tracks to an existing exported fleet
+    trace (Recorder.export_chrome_trace format)."""
+    with open(path) as f:
+        doc = json.load(f)
+    doc.setdefault("traceEvents", []).extend(
+        attribution_chrome_events(attr))
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def publish_attribution(attr: Dict[str, Any], rec=None,
+                        frame: Optional[int] = None) -> None:
+    """Publish a capture into the live fleet Recorder as an instant
+    event carrying the per-phase breakdown (shows up in the PR-17
+    Perfetto trace alongside the host-side spans)."""
+    rec = rec or _rec.get_recorder()
+    rec.event("phase_attribution", frame=frame,
+              wall_ms_per_frame=attr.get("wall_ms_per_frame"),
+              coverage=attr.get("coverage"),
+              **{f"ms_{name}": p.get("ms")
+                 for name, p in (attr.get("phases") or {}).items()})

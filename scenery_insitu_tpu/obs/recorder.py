@@ -119,6 +119,12 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                    "VDI fragment can be carried (gather/hybrid/plain/"
                    "particle modes, scan blocks); every frame "
                    "re-marches",
+    "divergence.modeled": "bench profiling: the model-vs-measured "
+                          "divergence report could not be produced "
+                          "(modeled projection missing or unreadable); "
+                          "the attribution and roofline verdicts still "
+                          "ride in the artifact (docs/OBSERVABILITY.md "
+                          "'Divergence engine')",
     "head.rank_down": "head node: a render rank went silent past "
                       "stale_frames; frames composite without it "
                       "(degraded flag) until it returns",
@@ -141,6 +147,11 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                            "loop; the last unflushed obs window was "
                            "dumped best-effort to the configured "
                            "trace/metrics paths",
+    "obs.profiler": "a ProfileCapture could not produce a phase "
+                    "attribution (trace backend absent, no trace "
+                    "emitted, or the HLO/trace join failed); the step "
+                    "keeps running unprofiled (docs/OBSERVABILITY.md "
+                    "'Phase attribution')",
     "slo.breach": "the live SLO engine saw a rolling-window quantile "
                   "cross its configured budget (metric and quantile in "
                   "the reason); the run keeps going, the breach is the "
@@ -304,6 +315,8 @@ _COUNTER_REGISTRY: Dict[str, str] = {
     "occupancy_kbudget_builds": "a K-budget occupancy plan was built",
     "occupancy_pyramid_builds": "an occupancy pyramid was (re)built",
     "occupancy_ranges_builds": "a brick range-signature set was built",
+    "profile_captures": "a ProfileCapture produced a phase attribution "
+                        "(traced frames joined back to sitpu_* scopes)",
     "rebalance_replans": "a rebalance replan (slab or brick-steal) was "
                          "executed",
     "rebalance_steps_built": "a render step was compiled for a "

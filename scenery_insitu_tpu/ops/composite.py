@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from scenery_insitu_tpu.config import CompositeConfig
 from scenery_insitu_tpu.core.vdi import VDI
+from scenery_insitu_tpu.obs.profiler import phase as _profile_phase
 from scenery_insitu_tpu.ops import supersegments as ss
 
 
@@ -60,7 +61,8 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
     if assume_sorted:
         sc, sd = flat_c, flat_d
     else:
-        sc, sd = sort_stream(flat_c, flat_d)
+        with _profile_phase("merge"):
+            sc, sd = sort_stream(flat_c, flat_d)
 
     k_out = cfg.max_output_supersegments
 
@@ -83,7 +85,8 @@ def composite_vdis(colors: jnp.ndarray, depths: jnp.ndarray,
                               flat_d.dtype)]) if pad else flat_d
         return VDI(color, depth)
 
-    return resegment_stream(sc, sd, cfg, gap_eps)
+    with _profile_phase("resegment"):
+        return resegment_stream(sc, sd, cfg, gap_eps)
 
 
 def sort_stream(flat_c: jnp.ndarray, flat_d: jnp.ndarray):

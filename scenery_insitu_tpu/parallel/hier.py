@@ -52,6 +52,7 @@ from jax.sharding import PartitionSpec as P
 
 from scenery_insitu_tpu.config import CompositeConfig, VDIConfig
 from scenery_insitu_tpu.core.vdi import VDI
+from scenery_insitu_tpu.obs.profiler import phase as _phase
 from scenery_insitu_tpu.core.volume import Volume
 from scenery_insitu_tpu.ops.composite import (composite_plain,
                                               resegment_stream,
@@ -140,14 +141,16 @@ def domain_accumulate(color: jnp.ndarray, depth: jnp.ndarray, d: int,
 
     k = color.shape[0]
     if comp_cfg.exchange == "ring" and d > 1:
-        color, depth = sort_stream(color, depth)
+        with _phase("merge"):
+            color, depth = sort_stream(color, depth)
         return _ring_accumulate(color, depth, d, ranks_axis,
                                 comp_cfg.wire, _ring_cap(comp_cfg, k))
     colors, depths = _exchange_vdi_columns(color, depth, d, ranks_axis,
                                            comp_cfg.wire)
     flat_c = colors.reshape((d * k,) + colors.shape[2:])
     flat_d = depths.reshape((d * k,) + depths.shape[2:])
-    return sort_stream(flat_c, flat_d)
+    with _phase("merge"):
+        return sort_stream(flat_c, flat_d)
 
 
 def hier_composite_vdi(color: jnp.ndarray, depth: jnp.ndarray,
@@ -173,8 +176,10 @@ def hier_composite_vdi(color: jnp.ndarray, depth: jnp.ndarray,
         # merge (the wire codec is the DCN byte lever, not truncation)
         acc_c, acc_d = _ring_accumulate(
             acc_c, acc_d, topo.num_hosts, topo.hosts_axis, topo.dcn_wire,
-            None, hop_counter="dcn_hops_built", hop_event="dcn_hop")
-    return resegment_stream(acc_c, acc_d, comp_cfg, gap_eps)
+            None, hop_counter="dcn_hops_built", hop_event="dcn_hop",
+            hop_scope="dcn_hop")
+    with _phase("resegment"):
+        return resegment_stream(acc_c, acc_d, comp_cfg, gap_eps)
 
 
 def hier_composite_plain(image: jnp.ndarray, depth: jnp.ndarray,
@@ -207,15 +212,18 @@ def hier_composite_plain(image: jnp.ndarray, depth: jnp.ndarray,
             image, depth, d, topo.ranks_axis,
             lambda i, z: _wire.encode_plain(i, z, wire),
             lambda i, z, s: _wire.decode_plain(i, z, s, wire))
-    partial = composite_plain(images, depths, (0.0, 0.0, 0.0, 0.0))
+    with _phase("merge"):
+        partial = composite_plain(images, depths, (0.0, 0.0, 0.0, 0.0))
     pdepth = jnp.min(depths, axis=0)        # nearest contribution, +inf empty
     if h == 1:
         bg = jnp.asarray(background, jnp.float32).reshape(4, 1, 1)
         return partial + (1.0 - partial[3:4]) * bg
     imgs2, deps2 = _ring_exchange_plain(
         partial, pdepth, h, topo.hosts_axis, topo.dcn_wire,
-        hop_counter="dcn_hops_built", build_counter="hier_plain_levels")
-    return composite_plain(imgs2, deps2, background)
+        hop_counter="dcn_hops_built", build_counter="hier_plain_levels",
+        hop_scope="dcn_hop")
+    with _phase("merge"):
+        return composite_plain(imgs2, deps2, background)
 
 
 # -------------------------------------------------------------- host path

@@ -93,6 +93,7 @@ from scenery_insitu_tpu.core.camera import Camera
 from scenery_insitu_tpu.core.transfer import TransferFunction
 from scenery_insitu_tpu.core.vdi import VDI
 from scenery_insitu_tpu.core.volume import Volume
+from scenery_insitu_tpu.obs.profiler import phase as _phase
 from scenery_insitu_tpu.ops.composite import composite_plain, composite_vdis
 from scenery_insitu_tpu.ops.raycast import raycast
 from scenery_insitu_tpu.ops.vdi_gen import generate_vdi
@@ -132,12 +133,14 @@ def _local_volume_and_clip(local_data: jnp.ndarray, origin: jnp.ndarray,
     dn = local_data.shape[0]
     dz = spacing[2]
     if plan is None:
-        halo = halo_exchange_z(local_data, axis_name)      # [Dn+2, H, W]
+        with _phase("halo"):
+            halo = halo_exchange_z(local_data, axis_name)  # [Dn+2, H, W]
         local_origin = origin.at[2].add((r * dn - 1) * dz)
         z_lo = origin[2] + r * dn * dz
         z_hi = origin[2] + (r + 1) * dn * dz
     else:
-        halo = reslab_z(local_data, plan, axis_name)       # [Pmax+2, H, W]
+        with _phase("halo"):
+            halo = reslab_z(local_data, plan, axis_name)   # [Pmax+2, H, W]
         g0, p_r = _plan_rank_band(plan, axis_name)
         local_origin = origin.at[2].add((g0 - 1) * dz)
         z_lo = origin[2] + g0 * dz
@@ -159,8 +162,9 @@ def _exchange_columns(x: jnp.ndarray, n: int, axis_name: str) -> jnp.ndarray:
     sizePerProcess = H*W*K*4/commSize, DistributedVolumes.kt:860-861)."""
     w = x.shape[-1]
     parts = jnp.moveaxis(x.reshape(x.shape[:-1] + (n, w // n)), -2, 0)
-    return jax.lax.all_to_all(parts, axis_name, split_axis=0, concat_axis=0,
-                              tiled=True)
+    with _phase("exchange"):
+        return jax.lax.all_to_all(parts, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
 
 
 def _column_blocks(x: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -185,12 +189,14 @@ def _encoded_all_to_all(a: jnp.ndarray, b: jnp.ndarray, n: int,
     scale (qpack8) has no W axis to split — it rides an ``all_gather``
     so every rank decodes each source fragment against its SENDER's
     normalization ([n, 2], row order == all_to_all's source order)."""
-    enc_a, enc_b, scale = encode(a, b)
+    with _phase("wire_encode"):
+        enc_a, enc_b, scale = encode(a, b)
     ra = _exchange_columns(enc_a, n, axis_name)
     rb = _exchange_columns(enc_b, n, axis_name)
     scales = (jax.lax.all_gather(scale, axis_name)
               if scale is not None else None)
-    return decode(ra, rb, scales)
+    with _phase("wire_encode"):
+        return decode(ra, rb, scales)
 
 
 def _exchange_vdi_columns(color: jnp.ndarray, depth: jnp.ndarray,
@@ -255,10 +261,12 @@ def _ring_exchange_composite(color: jnp.ndarray, depth: jnp.ndarray,
     # replaces the all_to_all path's N·K-wide post-exchange sort (the VDI
     # convention already promises front-to-back live slots; the sort makes
     # the merge's sorted-input precondition unconditional)
-    color, depth = sort_stream(color, depth)
+    with _phase("merge"):
+        color, depth = sort_stream(color, depth)
     acc_c, acc_d = _ring_accumulate(color, depth, n, axis_name, cfg.wire,
                                     cap)
-    return resegment_stream(acc_c, acc_d, cfg, gap_eps)
+    with _phase("resegment"):
+        return resegment_stream(acc_c, acc_d, cfg, gap_eps)
 
 
 def _ring_cap(cfg, k: int):
@@ -276,7 +284,8 @@ def _ring_cap(cfg, k: int):
 def _ring_accumulate(color: jnp.ndarray, depth: jnp.ndarray, n: int,
                      axis_name, wire: str, cap,
                      hop_counter: str = "ring_steps_built",
-                     hop_event: str = "ring_step"):
+                     hop_event: str = "ring_step",
+                     hop_scope: str = "exchange"):
     """The pipelined ring-merge core, shared by the single-level ring
     exchange above and the hierarchical composite's inter-domain (DCN)
     hop (parallel/hier.py): circulate each rank's column blocks of a
@@ -300,7 +309,9 @@ def _ring_accumulate(color: jnp.ndarray, depth: jnp.ndarray, n: int,
     if wire == "f32":
         enc_c, enc_d, scale = color, depth, None
     else:
-        enc_c, enc_d, scale = _wire.encode_fragment(color, depth, wire)
+        with _phase("wire_encode"):
+            enc_c, enc_d, scale = _wire.encode_fragment(color, depth,
+                                                        wire)
 
     def dec(c, d, sc):
         return _wire.decode_fragment(c, d, sc, wire)
@@ -316,16 +327,18 @@ def _ring_accumulate(color: jnp.ndarray, depth: jnp.ndarray, n: int,
         perm = [(i, (i - s) % n) for i in range(n)]
         send_c = _take_block(blk_c, jnp.mod(r - s, n))
         send_d = _take_block(blk_d, jnp.mod(r - s, n))
-        recv_c = jax.lax.ppermute(send_c, axis_name, perm)
-        recv_d = jax.lax.ppermute(send_d, axis_name, perm)
-        recv_s = (jax.lax.ppermute(scale, axis_name, perm)
-                  if scale is not None else None)
+        with _phase(hop_scope):
+            recv_c = jax.lax.ppermute(send_c, axis_name, perm)
+            recv_d = jax.lax.ppermute(send_d, axis_name, perm)
+            recv_s = (jax.lax.ppermute(scale, axis_name, perm)
+                      if scale is not None else None)
         rec.count(hop_counter)
         rec.event(hop_event, step=s, hops=s, frag_bytes=frag_bytes,
                   wire=wire)
-        mc, md = dec(recv_c, recv_d, recv_s)
-        acc_c, acc_d = merge_vdis_pairwise(acc_c, acc_d, mc, md,
-                                           k_cap=cap)
+        with _phase("merge"):
+            mc, md = dec(recv_c, recv_d, recv_s)
+            acc_c, acc_d = merge_vdis_pairwise(acc_c, acc_d, mc, md,
+                                               k_cap=cap)
     return acc_c, acc_d
 
 
@@ -349,7 +362,8 @@ def _composite_exchanged(color: jnp.ndarray, depth: jnp.ndarray,
                                         comp_cfg)
     colors, depths = _exchange_vdi_columns(color, depth, n, axis_name,
                                            comp_cfg.wire)
-    return composite_vdis(colors, depths, comp_cfg)
+    with _phase("merge"):
+        return composite_vdis(colors, depths, comp_cfg)
 
 
 # ------------------------------------------------------------- tile waves
@@ -369,12 +383,14 @@ def _wave_pipeline(n_waves: int, march_wave, compose, carry0=None):
     stacked on a leading wave axis, final carry). The prologue marches
     wave 0 and the epilogue composites wave T-1, so every wave is
     composited exactly once."""
-    frag, carry = march_wave(jnp.int32(0), carry0)
+    with _phase("wave"):
+        frag, carry = march_wave(jnp.int32(0), carry0)
 
     def body(c, w):
         fr, cr = c
         out = compose(fr)                  # wave w-1 circulates ...
-        fr2, cr = march_wave(w, cr)        # ... while wave w marches
+        with _phase("wave"):
+            fr2, cr = march_wave(w, cr)    # ... while wave w marches
         return (fr2, cr), out
 
     (frag, carry), outs = jax.lax.scan(body, (frag, carry),
@@ -728,8 +744,9 @@ def _brick_units(local_data, origin, spacing, spec, axis, n, bmap):
     units = []
     if bmap.max_level == 0:
         table = jnp.asarray(bmap.start_table(), jnp.int32)  # [n, B]
-        bands = reslab_bricks(local_data, bmap, axis,
-                              h=0 if z_march else 1)
+        with _phase("halo"):
+            bands = reslab_bricks(local_data, bmap, axis,
+                                  h=0 if z_march else 1)
         for s in range(bmap.slots):
             start = table[r, s]                            # -1 = absent
             present = start >= 0
@@ -757,7 +774,8 @@ def _brick_units(local_data, origin, spacing, spec, axis, n, bmap):
                 units.append((vol, vb, None, 1))
         return units, gmax, (w, h, d), units[0][0]
     halo = 0 if z_march else 1
-    bands = reslab_bricks_lod(local_data, bmap, axis, h=halo)
+    with _phase("halo"):
+        bands = reslab_bricks_lod(local_data, bmap, axis, h=halo)
     for lvl in bmap.levels_present():
         f = 1 << lvl
         arr = bands[lvl]
@@ -808,7 +826,8 @@ def _brick_clip_units(local_data, origin, spacing, d_global, axis, bmap):
     gmax = origin + jnp.array([w, h, d_global], jnp.float32) * spacing
     bz = bmap.brick_depth
     table = jnp.asarray(bmap.start_table(), jnp.int32)
-    bands = reslab_bricks(local_data, bmap, axis, h=1)
+    with _phase("halo"):
+        bands = reslab_bricks(local_data, bmap, axis, h=1)
     units = []
     for s in range(bmap.slots):
         start = table[r, s]
@@ -868,15 +887,17 @@ def _mxu_rank_generate_bricks(local_data, origin, spacing, cam, slicer,
     colors, depths, thr2s = [], [], []
     for s, (vol, vb, wb, f) in enumerate(units):
         axc = axcam if f == 1 else axcam._replace(dwm=axcam.dwm * f)
-        if threshold is None:
-            vdi, _, _ = slicer.generate_vdi_mxu(
-                vol, tf, cam, spec, vdi_cfg, v_bounds=vb, w_bounds=wb,
-                axcam=axc, step_scale=1.0 / f)
-        else:
-            vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
-                vol, tf, cam, spec, _thr_slot(threshold, s, nj), vdi_cfg,
-                v_bounds=vb, w_bounds=wb, axcam=axc, step_scale=1.0 / f)
-            thr2s.append(t2)
+        with _phase("march"):
+            if threshold is None:
+                vdi, _, _ = slicer.generate_vdi_mxu(
+                    vol, tf, cam, spec, vdi_cfg, v_bounds=vb,
+                    w_bounds=wb, axcam=axc, step_scale=1.0 / f)
+            else:
+                vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
+                    vol, tf, cam, spec, _thr_slot(threshold, s, nj),
+                    vdi_cfg, v_bounds=vb, w_bounds=wb, axcam=axc,
+                    step_scale=1.0 / f)
+                thr2s.append(t2)
         colors.append(vdi.color)
         depths.append(vdi.depth)
     thr2 = _stack_thr(thr2s) if thr2s else None
@@ -926,17 +947,18 @@ def _mxu_rank_generate_bricks_waves(local_data, origin, spacing, cam,
             thr_s = (None if thr_full is None else
                      jtu.tree_map(lambda m: slicer.wave_cols(m, n, t, w),
                                   _thr_slot(thr_full, s, nj)))
-            if thr_s is None:
-                vdi, _, _ = slicer.generate_vdi_mxu(
-                    vol, tf, cam, spec_w, vdi_cfg, v_bounds=vb,
-                    w_bounds=wb, occupancy=pyrs[s], axcam=axc,
-                    volp=volps[s], step_scale=1.0 / f)
-            else:
-                vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
-                    vol, tf, cam, spec_w, thr_s, vdi_cfg, v_bounds=vb,
-                    w_bounds=wb, occupancy=pyrs[s], axcam=axc,
-                    volp=volps[s], step_scale=1.0 / f)
-                t2s.append(t2)
+            with _phase("march"):
+                if thr_s is None:
+                    vdi, _, _ = slicer.generate_vdi_mxu(
+                        vol, tf, cam, spec_w, vdi_cfg, v_bounds=vb,
+                        w_bounds=wb, occupancy=pyrs[s], axcam=axc,
+                        volp=volps[s], step_scale=1.0 / f)
+                else:
+                    vdi, _, _, t2 = slicer.generate_vdi_mxu_temporal(
+                        vol, tf, cam, spec_w, thr_s, vdi_cfg,
+                        v_bounds=vb, w_bounds=wb, occupancy=pyrs[s],
+                        axcam=axc, volp=volps[s], step_scale=1.0 / f)
+                    t2s.append(t2)
             cs.append(vdi.color)
             ds.append(vdi.depth)
         if thr_full is not None:
@@ -963,7 +985,8 @@ def _mxu_rank_generate_bricks_waves(local_data, origin, spacing, cam,
 def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
                          n: int, axis_name: str, wire: str = "f32",
                          hop_counter: str = "ring_steps_built",
-                         build_counter: str = "ring_exchange_builds"):
+                         build_counter: str = "ring_exchange_builds",
+                         hop_scope: str = "exchange"):
     """Ring schedule for the plain-image exchange: n-1 single-fragment
     ppermute hops (pipelined like the VDI ring), then the stacked
     fragments are rolled back into SOURCE-RANK order so the downstream
@@ -980,7 +1003,8 @@ def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
     if wire == "f32":
         enc_i, enc_d, scale = image, depth, None
     else:
-        enc_i, enc_d, scale = _wire.encode_plain(image, depth, wire)
+        with _phase("wire_encode"):
+            enc_i, enc_d, scale = _wire.encode_plain(image, depth, wire)
 
     def dec(i, d, sc):
         return _wire.decode_plain(i, d, sc, wire)
@@ -995,13 +1019,15 @@ def _ring_exchange_plain(image: jnp.ndarray, depth: jnp.ndarray,
     frags_d = [own_d]
     for s in range(1, n):
         perm = [(i, (i - s) % n) for i in range(n)]
-        recv_i = jax.lax.ppermute(
-            _take_block(blk_i, jnp.mod(r - s, n)), axis_name, perm)
-        recv_d = jax.lax.ppermute(
-            _take_block(blk_d, jnp.mod(r - s, n)), axis_name, perm)
-        recv_s = (jax.lax.ppermute(scale, axis_name, perm)
-                  if scale is not None else None)
-        di, dd = dec(recv_i, recv_d, recv_s)
+        with _phase(hop_scope):
+            recv_i = jax.lax.ppermute(
+                _take_block(blk_i, jnp.mod(r - s, n)), axis_name, perm)
+            recv_d = jax.lax.ppermute(
+                _take_block(blk_d, jnp.mod(r - s, n)), axis_name, perm)
+            recv_s = (jax.lax.ppermute(scale, axis_name, perm)
+                      if scale is not None else None)
+        with _phase("wire_encode"):
+            di, dd = dec(recv_i, recv_d, recv_s)
         frags_i.append(di)
         frags_d.append(dd)
         rec.count(hop_counter)
@@ -1038,7 +1064,8 @@ def _composite_plain_exchanged(image: jnp.ndarray, depth: jnp.ndarray,
             image, depth, n, axis_name,
             lambda i, d: _wire.encode_plain(i, d, wire),
             lambda i, d, s: _wire.decode_plain(i, d, s, wire))
-    return composite_plain(images, depths, background)
+    with _phase("merge"):
+        return composite_plain(images, depths, background)
 
 
 def _composite_plain_waves(image: jnp.ndarray, depth: jnp.ndarray,
@@ -1128,10 +1155,12 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
             smin = origin
             cs, ds = [], []
             for vol, cmin, cmax in units:
-                vdi, _ = generate_vdi(vol, tf, cam, width, height,
-                                      vdi_cfg, max_steps=max_steps,
-                                      clip_min=cmin, clip_max=cmax,
-                                      sample_min=smin, sample_max=smax)
+                with _phase("march"):
+                    vdi, _ = generate_vdi(vol, tf, cam, width, height,
+                                          vdi_cfg, max_steps=max_steps,
+                                          clip_min=cmin, clip_max=cmax,
+                                          sample_min=smin,
+                                          sample_max=smax)
                 cs.append(vdi.color)
                 ds.append(vdi.depth)
             return _composite_exchanged_sched(
@@ -1139,10 +1168,11 @@ def distributed_vdi_step(mesh: Mesh, tf: TransferFunction,
                 n, axis, comp_cfg, topo=topo)
         vol, cmin, cmax, smin, smax = _local_volume_and_clip(
             local_data, origin, spacing, d_global, axis, plan=plan)
-        vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
-                              max_steps=max_steps, clip_min=cmin,
-                              clip_max=cmax, sample_min=smin,
-                              sample_max=smax)
+        with _phase("march"):
+            vdi, _ = generate_vdi(vol, tf, cam, width, height, vdi_cfg,
+                                  max_steps=max_steps, clip_min=cmin,
+                                  clip_max=cmax, sample_min=smin,
+                                  sample_max=smax)
         return _composite_exchanged_sched(vdi.color, vdi.depth, n, axis,
                                           comp_cfg, topo=topo)
 
@@ -1195,7 +1225,8 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
 
     if shade is not None:
         hr = shade_halo + 1
-        ext = halo_exchange_z(local_data, axis, h=hr)
+        with _phase("halo"):
+            ext = halo_exchange_z(local_data, axis, h=hr)
         ext_origin = origin.at[2].add((r * dn - hr) * dz)
         local_data = shade(Volume(ext, ext_origin, spacing)).data
         if getattr(spec, "render_dtype", "f32") == "bf16" \
@@ -1223,7 +1254,8 @@ def _rank_slab(local_data, origin, spacing, spec, axis, n,
         if shade is not None:
             halo = z_slice(shade_halo, shade_halo + dn + 2)
         else:
-            halo = halo_exchange_z(local_data, axis)       # [Dn+2, H, W]
+            with _phase("halo"):
+                halo = halo_exchange_z(local_data, axis)   # [Dn+2, H, W]
         local_origin = origin.at[2].add((r * dn - 1) * dz)
         vol = Volume(halo, local_origin, spacing)
         z_lo = origin[2] + r * dn * dz
@@ -1259,7 +1291,8 @@ def _planned_slab(local_data, origin, spacing, spec, axis, n,
 
     if shade is not None:
         hr = shade_halo + 1
-        ext = reslab_z(local_data, plan, axis, h=hr)
+        with _phase("halo"):
+            ext = reslab_z(local_data, plan, axis, h=hr)
         ext_origin = origin.at[2].add((g0 - hr) * dz)
         shaded = shade(Volume(ext, ext_origin, spacing)).data
         if getattr(spec, "render_dtype", "f32") == "bf16" \
@@ -1279,7 +1312,9 @@ def _planned_slab(local_data, origin, spacing, spec, axis, n,
         if shade is not None:
             band = z_slice(hr, hr + pmax)
         else:
-            band = reslab_z(local_data, plan, axis, h=0)   # [Pmax, H, W]
+            with _phase("halo"):
+                band = reslab_z(local_data, plan, axis,
+                                h=0)                       # [Pmax, H, W]
         local_origin = origin.at[2].add(g0 * dz)
         vol = Volume(band, local_origin, spacing)
         return vol, gmax, None, (z_lo, z_hi), (w, h, dn * n)
@@ -1289,7 +1324,8 @@ def _planned_slab(local_data, origin, spacing, spec, axis, n,
     if shade is not None:
         band = z_slice(hr - 1, hr + pmax + 1)              # [Pmax+2, ...]
     else:
-        band = reslab_z(local_data, plan, axis)            # [Pmax+2, H, W]
+        with _phase("halo"):
+            band = reslab_z(local_data, plan, axis)        # [Pmax+2, H, W]
     local_origin = origin.at[2].add((g0 - 1) * dz)
     vol = Volume(band, local_origin, spacing)
     # same edge-rank slack as the even path: rank n-1 owns the global
@@ -1326,7 +1362,8 @@ def _rank_frame_state(local_data, origin, spacing, spec, tf, vdi_cfg,
     if spec.skip_empty or budgeted or need_pyramid:
         from scenery_insitu_tpu.ops import occupancy as _occ
 
-        occ_pyr = _occ.pyramid_from_volume(vol, tf, spec)
+        with _phase("march"):
+            occ_pyr = _occ.pyramid_from_volume(vol, tf, spec)
     if budgeted:
         from scenery_insitu_tpu import obs as _obs
         from scenery_insitu_tpu.ops import occupancy as _occ
@@ -1374,17 +1411,20 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
                           axis, n, comp_cfg, plan=plan,
                           need_pyramid=reuse is not None)
     if reuse is None:
-        if threshold is None:
-            vdi, meta, axcam = slicer.generate_vdi_mxu(
-                vol, tf, cam, spec, vdi_cfg,
-                box_min=origin, box_max=gmax, v_bounds=v_bounds,
-                occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
-            thr2 = None
-        else:
-            vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
-                vol, tf, cam, spec, threshold, vdi_cfg,
-                box_min=origin, box_max=gmax, v_bounds=v_bounds,
-                occupancy=occ_pyr, k_target=k_target, w_bounds=w_bounds)
+        with _phase("march"):
+            if threshold is None:
+                vdi, meta, axcam = slicer.generate_vdi_mxu(
+                    vol, tf, cam, spec, vdi_cfg,
+                    box_min=origin, box_max=gmax, v_bounds=v_bounds,
+                    occupancy=occ_pyr, k_target=k_target,
+                    w_bounds=w_bounds)
+                thr2 = None
+            else:
+                vdi, meta, axcam, thr2 = slicer.generate_vdi_mxu_temporal(
+                    vol, tf, cam, spec, threshold, vdi_cfg,
+                    box_min=origin, box_max=gmax, v_bounds=v_bounds,
+                    occupancy=occ_pyr, k_target=k_target,
+                    w_bounds=w_bounds)
         # metadata must describe the GLOBAL volume, not this rank's slab
         meta = meta._replace(volume_dims=jnp.array(dims, jnp.float32))
         return vdi, meta, axcam, thr2, None
@@ -1398,17 +1438,18 @@ def _mxu_rank_generate(local_data, origin, spacing, cam, slicer, spec,
                                2 * occ_pyr.lo.size)
 
     def marched(_):
-        if threshold is None:
-            vdi, _, _ = slicer.generate_vdi_mxu(
-                vol, tf, cam, spec, vdi_cfg, v_bounds=v_bounds,
-                occupancy=occ_pyr, k_target=k_target, axcam=axcam,
-                w_bounds=w_bounds)
-            return vdi.color, vdi.depth
-        vdi, _, _, thr2 = slicer.generate_vdi_mxu_temporal(
-            vol, tf, cam, spec, threshold, vdi_cfg, v_bounds=v_bounds,
-            occupancy=occ_pyr, k_target=k_target, axcam=axcam,
-            w_bounds=w_bounds)
-        return vdi.color, vdi.depth, thr2
+        with _phase("march"):
+            if threshold is None:
+                vdi, _, _ = slicer.generate_vdi_mxu(
+                    vol, tf, cam, spec, vdi_cfg, v_bounds=v_bounds,
+                    occupancy=occ_pyr, k_target=k_target, axcam=axcam,
+                    w_bounds=w_bounds)
+                return vdi.color, vdi.depth
+            vdi, _, _, thr2 = slicer.generate_vdi_mxu_temporal(
+                vol, tf, cam, spec, threshold, vdi_cfg,
+                v_bounds=v_bounds, occupancy=occ_pyr, k_target=k_target,
+                axcam=axcam, w_bounds=w_bounds)
+            return vdi.color, vdi.depth, thr2
 
     def kept(_):
         # a clean rank: last frame's fragment IS this frame's (the
@@ -1492,17 +1533,20 @@ def _mxu_rank_generate_waves(local_data, origin, spacing, cam, slicer,
                               thr_full))
 
         def marched(_):
-            if thr_w is None:
-                vdi, _, _ = slicer.generate_vdi_mxu(
-                    vol, tf, cam, spec_w, vdi_cfg, v_bounds=v_bounds,
-                    occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
-                    volp=volp, w_bounds=w_bounds)
-                return vdi.color, vdi.depth
-            vdi, _, _, thr2w = slicer.generate_vdi_mxu_temporal(
-                vol, tf, cam, spec_w, thr_w, vdi_cfg, v_bounds=v_bounds,
-                occupancy=occ_pyr, k_target=k_target, axcam=axcam_w,
-                volp=volp, w_bounds=w_bounds)
-            return vdi.color, vdi.depth, thr2w
+            with _phase("march"):
+                if thr_w is None:
+                    vdi, _, _ = slicer.generate_vdi_mxu(
+                        vol, tf, cam, spec_w, vdi_cfg,
+                        v_bounds=v_bounds, occupancy=occ_pyr,
+                        k_target=k_target, axcam=axcam_w, volp=volp,
+                        w_bounds=w_bounds)
+                    return vdi.color, vdi.depth
+                vdi, _, _, thr2w = slicer.generate_vdi_mxu_temporal(
+                    vol, tf, cam, spec_w, thr_w, vdi_cfg,
+                    v_bounds=v_bounds, occupancy=occ_pyr,
+                    k_target=k_target, axcam=axcam_w, volp=volp,
+                    w_bounds=w_bounds)
+                return vdi.color, vdi.depth, thr2w
 
         if reuse is None:
             out = marched(None)
@@ -1856,9 +1900,10 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
             # [Ko, ·, Nj, Ni/n]
 
         # sort-first particle pass on the virtual camera's rays
-        sp = sort_first_splat(tr_pos, tr_vel, axis, spec.ni, spec.nj,
-                              radius, stamp, colormap,
-                              view=axcam.view, proj=axcam.proj)
+        with _phase("march"):
+            sp = sort_first_splat(tr_pos, tr_vel, axis, spec.ni,
+                                  spec.nj, radius, stamp, colormap,
+                                  view=axcam.view, proj=axcam.proj)
 
         # my column block of the (replicated) particle layer — under a
         # hierarchical topology the composite hands this rank the block
@@ -1867,7 +1912,9 @@ def distributed_hybrid_step_mxu(mesh: Mesh, tf: TransferFunction,
         wb = spec.ni // n
         img_b = jax.lax.dynamic_slice_in_dim(sp.image, r * wb, wb, axis=2)
         dep_b = jax.lax.dynamic_slice_in_dim(sp.depth, r * wb, wb, axis=1)
-        hyb = composite_vdi_with_particles(comp, SplatOutput(img_b, dep_b))
+        with _phase("merge"):
+            hyb = composite_vdi_with_particles(
+                comp, SplatOutput(img_b, dep_b))
         return hyb, meta, thr2
 
     from scenery_insitu_tpu.core.vdi import VDIMetadata
@@ -2025,23 +2072,24 @@ def distributed_plain_step_mxu(mesh: Mesh, tf: TransferFunction,
             def march_wave(w, _):
                 axcam_w, spec_w = slicer.wave_camera(axcam, spec, n,
                                                      wave_tiles, w)
-                out = slicer.render_slices(vol, tf_r, axcam_w, spec_w,
-                                           cfg.early_exit_alpha,
-                                           v_bounds=v_bounds,
-                                           step_scale=cfg.step_scale,
-                                           occupancy=occ, volp=volp,
-                                           w_bounds=w_bounds)
+                with _phase("march"):
+                    out = slicer.render_slices(
+                        vol, tf_r, axcam_w, spec_w,
+                        cfg.early_exit_alpha, v_bounds=v_bounds,
+                        step_scale=cfg.step_scale, occupancy=occ,
+                        volp=volp, w_bounds=w_bounds)
                 return (out.image, out.depth), None
 
             img = _composite_plain_waves(
                 None, None, n, axis, bg, exchange, wire, wave_tiles,
                 march_wave=march_wave, topo=topo)
             return img, axcam
-        out = slicer.render_slices(vol, tf_r, axcam, spec,
-                                   cfg.early_exit_alpha,
-                                   v_bounds=v_bounds,
-                                   step_scale=cfg.step_scale,
-                                   w_bounds=w_bounds)
+        with _phase("march"):
+            out = slicer.render_slices(vol, tf_r, axcam, spec,
+                                       cfg.early_exit_alpha,
+                                       v_bounds=v_bounds,
+                                       step_scale=cfg.step_scale,
+                                       w_bounds=w_bounds)
         return _composite_plain_exchanged(out.image, out.depth, n, axis,
                                           bg, exchange, wire,
                                           topo=topo), axcam
@@ -2127,22 +2175,26 @@ def distributed_plain_step(mesh: Mesh, tf: TransferFunction,
             dn = local_data.shape[0]
             hr = cfg.ao_radius + 1
             if plan is None:
-                ext = halo_exchange_z(local_data, axis, h=hr)
+                with _phase("halo"):
+                    ext = halo_exchange_z(local_data, axis, h=hr)
                 n_keep = dn
             else:
                 # the occlusion blur needs the radius-deep halo around
                 # the PLANNED band; the trim below keeps the band's
                 # 1-halo extent (matches vol.data row-for-row)
-                ext = reslab_z(local_data, plan, axis, h=hr)
+                with _phase("halo"):
+                    ext = reslab_z(local_data, plan, axis, h=hr)
                 n_keep = int(max(plan))
-            occ = _ao.occlusion_field(
-                _ao.tf_alpha(Volume(ext, vol.origin, spacing), tf),
-                cfg.ao_radius, cfg.ao_strength)
+            with _phase("march"):
+                occ = _ao.occlusion_field(
+                    _ao.tf_alpha(Volume(ext, vol.origin, spacing), tf),
+                    cfg.ao_radius, cfg.ao_strength)
             ao_vol = Volume(occ[hr - 1:hr + n_keep + 1], vol.origin,
                             spacing)
-        out = raycast(vol, tf, cam, width, height, rank_cfg,
-                      clip_min=cmin, clip_max=cmax, ao_field=ao_vol,
-                      sample_min=smin, sample_max=smax)
+        with _phase("march"):
+            out = raycast(vol, tf, cam, width, height, rank_cfg,
+                          clip_min=cmin, clip_max=cmax, ao_field=ao_vol,
+                          sample_min=smin, sample_max=smax)
         if waves:
             return _composite_plain_waves(out.image, out.depth, n, axis,
                                           cfg.background, exchange, wire,
@@ -2220,10 +2272,12 @@ def frame_scan(step, advance, frames: int, temporal: bool = False,
         def body(carry, _):
             st, cam, thr = carry
             if sim_ranges:
-                st, rng = advance(st)
+                with _phase("sim_step"):
+                    st, rng = advance(st)
                 extra = (rng,)
             else:
-                st = advance(st)
+                with _phase("sim_step"):
+                    st = advance(st)
                 extra = ()
             if temporal:
                 out, thr2 = step(field(st), origin, spacing, cam, thr,

@@ -1,0 +1,243 @@
+"""Async delivery A/B (docs/PERF.md "Async delivery"): with a heavy
+compressing sink on the frame stream, the serial loop pays
+device + host every frame; the delivery plane (ISSUE 19 tentpole —
+RuntimeConfig.pipeline_depth + DeliveryConfig) must take the host term
+off the critical path so the loop pays ~max(device, host) and the
+EXPOSED host time (delivery work still running on the loop thread)
+collapses.
+
+The A/B runs the real InSituSession on the virtual CPU mesh with one
+deflate-6 frame sink (what vdi_sink's codec actually costs) across:
+
+- **serial**:   delivery disabled, pipeline_depth=1 — the pre-PR-19
+                behavior, every sink inline on the loop thread;
+- **async d1/d2/d4**: delivery enabled at pipeline depth 1/2/4 — the
+                sink runs on the delivery worker; the loop's only
+                delivery cost is the (async-started) host copy.
+
+Per arm it reports frame ms, exposed host ms (sink seconds observed ON
+the loop thread), delivery lag p50/p99 from the SLO engine, the
+delivery counters, and the bit-exactness verdict: a running digest of
+every delivered (frame, color, depth) byte stream, which must be
+IDENTICAL across all arms (the ordering contract: frames strictly
+FIFO, payload bytes untouched by the executor).
+
+A second section A/Bs the parallel per-tile encode satellite:
+io.vdi_io.save_vdi with workers=1 vs workers=N on the same VDI — the
+artifacts must be byte-identical (per-member compress calls are
+independent; only the wall clock may change).
+
+Acceptance (regression_gate family ``delivery_ab``): async exposed
+host <= 0.5x serial, delivered bytes bit-identical, tile encode
+byte-identical. Writes one JSON artifact (--out; committed as
+results/delivery_ab_r19_cpu.json).
+
+Runs anywhere: re-execs itself onto an N-device virtual CPU mesh
+(SITPU_DELIVERY_RANKS, default 4) exactly like delta_bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = "_SITPU_DELIVERYBENCH_CHILD"
+
+from scenery_insitu_tpu.utils.backend import (pin_cpu_backend,  # noqa: E402
+                                              reexec_virtual_mesh)
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+class HeavySink:
+    """Deflate-6 compressing frame sink with per-call accounting: which
+    thread ran it, how long it took, and a running digest of the
+    delivered byte stream (frame index + raw color/depth bytes) for the
+    cross-arm bit-exactness verdict."""
+
+    def __init__(self, level: int = 6):
+        self.level = level
+        self.lock = threading.Lock()
+        self.calls = []                 # (frame, thread_name, seconds)
+        self._digest = hashlib.sha256()
+        self.bytes_compressed = 0
+
+    def __call__(self, index: int, payload: dict) -> None:
+        import numpy as np
+
+        t0 = time.perf_counter()
+        blob = (np.asarray(payload["vdi_color"]).tobytes()
+                + np.asarray(payload["vdi_depth"]).tobytes())
+        zlib.crc32(blob)
+        comp = zlib.compress(blob, self.level)
+        dt = time.perf_counter() - t0
+        with self.lock:
+            self._digest.update(str(int(payload["frame"])).encode())
+            self._digest.update(blob)
+            self.calls.append((int(payload["frame"]),
+                               threading.current_thread().name, dt))
+            self.bytes_compressed += len(comp)
+
+    @property
+    def digest(self) -> str:
+        return self._digest.hexdigest()
+
+
+def _base_cfg(width: int, height: int):
+    from scenery_insitu_tpu.config import FrameworkConfig
+
+    return FrameworkConfig().with_overrides(
+        f"render.width={width}", f"render.height={height}",
+        "render.max_steps=48", "vdi.max_supersegments=8",
+        "vdi.adaptive_iters=2", "composite.max_output_supersegments=12",
+        "composite.adaptive_iters=2", "sim.grid=[32,32,32]",
+        "sim.steps_per_frame=2", "runtime.stats_window=4",
+        "slo.enabled=true")
+
+
+def _run_arm(name, overrides, frames, ranks, width, height):
+    """One session run under one delivery configuration; returns the
+    measurements the A/B compares."""
+    from scenery_insitu_tpu.parallel.mesh import make_mesh
+    from scenery_insitu_tpu.runtime.session import InSituSession
+
+    sink = HeavySink()
+    cfg = _base_cfg(width, height).with_overrides(*overrides)
+    sess = InSituSession(cfg, mesh=make_mesh(ranks), sinks=[sink])
+    loop_thread = threading.current_thread().name
+    t0 = time.perf_counter()
+    sess.run(frames)                    # drains delivery before returning
+    wall_ms = (time.perf_counter() - t0) * 1e3
+
+    order = [f for f, _, _ in sink.calls]
+    exposed_s = sum(dt for _, th, dt in sink.calls if th == loop_thread)
+    offloaded_s = sum(dt for _, th, dt in sink.calls if th != loop_thread)
+    lag = (sess.slo.snapshot()["metrics"] or {}).get("delivery_lag_ms")
+    counters = {k: v for k, v in sorted(sess.obs.counters.items())
+                if k.startswith("delivery_")}
+    return {
+        "arm": name,
+        "config": {ov.split("=")[0]: ov.split("=")[1] for ov in overrides},
+        "frames_delivered": len(order),
+        "ordering_fifo": order == sorted(order) and len(set(order)) == len(order),
+        "frame_ms": round(wall_ms / frames, 3),
+        "exposed_host_ms_per_frame": round(exposed_s * 1e3 / frames, 3),
+        "offloaded_host_ms_per_frame": round(offloaded_s * 1e3 / frames,
+                                             3),
+        "delivery_lag_p50_ms": (lag or {}).get("p50"),
+        "delivery_lag_p99_ms": (lag or {}).get("p99"),
+        "counters": counters,
+        "compressed_bytes": sink.bytes_compressed,
+        "digest": sink.digest,
+    }
+
+
+def _tile_encode_ab(workers: int, tmpdir: str):
+    """save_vdi workers=1 vs workers=N on one synthetic VDI: artifacts
+    must be byte-identical (the parallel per-tile encode contract)."""
+    import numpy as np
+
+    from scenery_insitu_tpu.core.vdi import VDI
+    from scenery_insitu_tpu.io.vdi_io import save_vdi
+
+    rng = np.random.default_rng(7)
+    vdi = VDI(color=rng.random((16, 4, 128, 160), np.float32),
+              depth=np.sort(rng.random((16, 2, 128, 160),
+                                       np.float32), axis=1))
+    out = {}
+    blobs = {}
+    for w in (1, workers):
+        path = os.path.join(tmpdir, f"enc_w{w}.npz")
+        t0 = time.perf_counter()
+        save_vdi(path, vdi, codec="zlib", workers=w)
+        out[f"ms_workers{w}"] = round((time.perf_counter() - t0) * 1e3, 2)
+        with open(path, "rb") as f:
+            blobs[w] = f.read()
+    out["workers"] = workers
+    out["byte_identical"] = blobs[1] == blobs[workers]
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--frames",
+                    default=_env_int("SITPU_DELIVERY_FRAMES", 8),
+                    type=int)
+    ap.add_argument("--ranks",
+                    default=_env_int("SITPU_DELIVERY_RANKS", 4), type=int)
+    ap.add_argument("--width", default=128, type=int)
+    ap.add_argument("--height", default=96, type=int)
+    ap.add_argument("--encode-workers", default=4, type=int)
+    args = ap.parse_args()
+
+    if os.environ.get(_CHILD) != "1":
+        reexec_virtual_mesh(args.ranks, _CHILD)
+    pin_cpu_backend()
+
+    arms = {
+        "serial": ["delivery.enabled=false", "runtime.pipeline_depth=1"],
+        "async_d1": ["delivery.enabled=true", "runtime.pipeline_depth=1"],
+        "async_d2": ["delivery.enabled=true", "runtime.pipeline_depth=2"],
+        "async_d4": ["delivery.enabled=true", "runtime.pipeline_depth=4"],
+    }
+    results = {}
+    for name, ovs in arms.items():
+        results[name] = _run_arm(name, ovs, args.frames, args.ranks,
+                                 args.width, args.height)
+        print(f"[delivery] {name}: frame "
+              f"{results[name]['frame_ms']} ms, exposed host "
+              f"{results[name]['exposed_host_ms_per_frame']} ms",
+              file=sys.stderr)
+
+    serial = results["serial"]
+    bit_identical = all(r["digest"] == serial["digest"]
+                        for r in results.values())
+    ordering = all(r["ordering_fifo"] for r in results.values())
+    exp0 = serial["exposed_host_ms_per_frame"]
+    best = results["async_d4"]["exposed_host_ms_per_frame"]
+    ratio = round(best / exp0, 4) if exp0 > 0 else None
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        tile_encode = _tile_encode_ab(args.encode_workers, td)
+
+    out = {
+        "metric": f"delivery_ab_{args.ranks}rank_cpu",
+        "value": ratio,
+        "unit": "async/serial exposed host ratio (lower is better)",
+        "frames": args.frames,
+        "render": [args.width, args.height],
+        "sink": "deflate-6 frame compressor (vdi_sink codec class)",
+        "arms": results,
+        "bit_identical_all": bit_identical,
+        "ordering_fifo_all": ordering,
+        "tile_encode": tile_encode,
+        "note": "exposed host = sink seconds observed on the loop "
+                "thread; async arms run the sink on the delivery "
+                "worker, so the loop only pays the async-started host "
+                "copy — delivered bytes must stay bit-identical "
+                "(FIFO frames, untouched payloads) across every arm",
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    # hard acceptance: overlap pays and correctness holds
+    ok = bit_identical and ordering and (ratio is None or ratio <= 0.5)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

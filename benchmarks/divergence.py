@@ -41,17 +41,21 @@ RESULTS_DIR = os.path.join(_HERE, "results")
 
 # Measured phase names (obs/profiler.py PHASES + synthetic) → the
 # modeled stack's per-lever "ms" keys (benchmarks/model_projection.py).
-# host + unattributed land in the "unmodeled" bucket — the model
-# explicitly excludes dispatch/host time, so that residual belongs to
-# no lever and its share IS the model's stated blind spot.
+# Since PR 19 the host-delivery path (tile slicing, compression, CRC,
+# sinks — measured through ProfileCapture's host_time_fn hook) is a
+# modeled lever (bytes × codec throughput, overlap factor from
+# pipeline_depth), so "host" joins the lever table; only "unattributed"
+# stays in the unmodeled bucket — device time the sitpu_* scopes could
+# not explain, the model's remaining stated blind spot.
 LEVER_PHASES: Dict[str, tuple] = {
     "sim": ("sim_step",),
     "march": ("march", "halo", "wave"),
     "composite_stream": ("merge", "resegment", "wire_encode"),
     "exchange_exposed": ("exchange",),
     "dcn_exchange": ("dcn_hop",),
+    "host_delivery": ("host",),
 }
-UNMODELED = ("host", "unattributed")
+UNMODELED = ("unattributed",)
 
 
 def latest_modeled(results_dir: str = RESULTS_DIR) -> Optional[str]:

@@ -26,6 +26,13 @@ temporal adaptive = one march/frame):
              regime PR 6 measured at live-cell 0.41
 - composite: the same model's stream_bytes_per_rank (merge working set
              + k_out output write)
+- delivery:  the host delivery plane (PR 19) — one rank's frame share
+             over PCIe plus a codec sweep (quantize/pack + CRC) of the
+             input bytes; every ladder row prices it SERIALLY (the
+             pre-PR-19 critical path where the loop blocks on
+             np.asarray and encodes inline) and the +async_delivery
+             scenario row shows the depth-k pipeline + encode-worker
+             fan-out leaving only max(0, host - device) exposed
 
 Every row converts bytes -> ms with the stated bandwidth assumptions and
 adds them (a traffic LOWER BOUND: compute, dispatch and host time are
@@ -72,6 +79,21 @@ ICI_GBPS = 45.0
 # slow level by construction, which is the whole reason the composite
 # splits into two levels instead of running one flat exchange over it.
 DCN_GBPS = 3.125
+# ---- host delivery plane (PR 19) ----
+# PCIe Gen4 x16 assumption for the device->host copy of the rendered
+# frame (the copy the async fetch overlaps behind the next dispatch).
+PCIE_GBPS = 32.0
+# single-worker codec throughput over the INPUT f32 bytes of the
+# delivery path — qpack8 quantize/pack + CRC32 (or memcpy + CRC32 on
+# an f32 wire): vectorized quantize plus zlib.crc32 land around
+# 2 GB/s/core on the CPU reference; deflate-class codecs are slower
+# and belong in delivery_bench's heavy-sink scenario, not here.
+CODEC_GBPS = 2.0
+# the committed async-delivery configuration (RuntimeConfig
+# .pipeline_depth / DeliveryConfig.encode_workers defaults the bench
+# sweeps around)
+DELIVERY_WORKERS = 4
+PIPELINE_DEPTH = 4
 
 
 def _load(rel, default=None):
@@ -117,6 +139,20 @@ def main():
     def ms_ici(nbytes):
         return nbytes / (ICI_GBPS * 1e9) * 1e3
 
+    # one rank's share of the delivered frame: K supersegments x
+    # (4 color + 2 depth) planes x NI x NJ f32 over RANKS column bands —
+    # the payload _fetch hands the delivery plane every frame
+    frame_bytes_per_rank = K * 6 * NI * NJ * 4 // RANKS
+
+    def ms_host_delivery(workers=1):
+        """Serial host cost of delivering one rank's frame share:
+        device->host copy over PCIe plus the codec sweep (quantize/pack
+        + CRC) over the input bytes, fanned across ``workers`` per-tile
+        encode threads (PCIe is serial regardless — one link)."""
+        copy = frame_bytes_per_rank / (PCIE_GBPS * 1e9) * 1e3
+        codec = frame_bytes_per_rank / (CODEC_GBPS * workers * 1e9) * 1e3
+        return copy + codec
+
     def row(lever, sim_fused, march_bytes_per_vox, march_scale,
             exchange, wire, ring_slots, schedule, note):
         sim_b = ps.modeled_sim_traffic(slab, SIM_STEPS, fused=sim_fused)
@@ -128,7 +164,13 @@ def main():
         ici_b = (ex["ici_bytes_exposed_per_rank"]
                  if schedule == "waves" else ex["ici_bytes_per_rank"])
         stream_b = ex["stream_bytes_per_rank"]
-        total = (ms_hbm(sim_b + march_b + stream_b) + ms_ici(ici_b))
+        # every ladder row prices delivery SERIALLY (pipeline_depth=1,
+        # the pre-PR-19 behavior): the host term sits fully on the
+        # frame's critical path — the +async_delivery scenario row at
+        # the end is where it comes off
+        host = ms_host_delivery()
+        total = (ms_hbm(sim_b + march_b + stream_b) + ms_ici(ici_b)
+                 + host)
         return {
             "lever": lever,
             "config": {"sim_fused": sim_fused,
@@ -136,16 +178,19 @@ def main():
                                         else "f32"),
                        "occupancy_march_reduction": march_scale,
                        "exchange": exchange, "wire": wire,
-                       "ring_slots": ring_slots, "schedule": schedule},
+                       "ring_slots": ring_slots, "schedule": schedule,
+                       "pipeline_depth": 1, "delivery": "serial"},
             "bytes": {"sim_hbm": round(sim_b),
                       "march_hbm": round(march_b),
                       "composite_stream_hbm": round(stream_b),
                       "exchange_ici_exposed": round(ici_b),
-                      "exchange_ici_total": ex["ici_bytes_per_rank"]},
+                      "exchange_ici_total": ex["ici_bytes_per_rank"],
+                      "delivery_host": frame_bytes_per_rank},
             "ms": {"sim": round(ms_hbm(sim_b), 2),
                    "march": round(ms_hbm(march_b), 2),
                    "composite_stream": round(ms_hbm(stream_b), 3),
-                   "exchange_exposed": round(ms_ici(ici_b), 3)},
+                   "exchange_exposed": round(ms_ici(ici_b), 3),
+                   "host_delivery": round(host, 2)},
             "modeled_ms_per_frame": round(total, 2),
             "note": note,
         }
@@ -340,6 +385,47 @@ def main():
                     f"{GRID * hosts}x{GRID}x{GRID}",
         })
 
+    # ---- async delivery plane (ISSUE 19): every row above prices the
+    # host delivery path (device->host copy + codec + sinks) SERIALLY —
+    # the pre-PR-19 critical path, where the render loop blocks on
+    # np.asarray and then encodes inline. The delivery executor takes it
+    # off that path: with pipeline_depth >= 2 the async fetch of frame
+    # i-1 and the worker-tier encode overlap frame i's dispatch, so the
+    # steady-state frame is max(device, host), not device + host — the
+    # exposed host term is what max() leaves sticking out. encode
+    # workers fan the codec sweep across cores; the PCIe copy stays
+    # serial (one link). depth bounds how many frames of host jitter the
+    # bounded queue absorbs before the block/drop_oldest policy engages;
+    # the steady-state model below assumes the queue never saturates.
+    full_stack = next(r for r in stack if r["lever"] == "+tile_waves")
+    host_serial = ms_host_delivery()
+    host_async = ms_host_delivery(DELIVERY_WORKERS)
+    ms = dict(full_stack["ms"])
+    device_total = sum(v for k, v in ms.items() if k != "host_delivery")
+    exposed = max(0.0, host_async - device_total)
+    ms["host_delivery"] = round(exposed, 2)
+    stack.append({
+        "lever": "+async_delivery",
+        "config": {**full_stack["config"],
+                   "pipeline_depth": PIPELINE_DEPTH,
+                   "delivery": "async",
+                   "encode_workers": DELIVERY_WORKERS},
+        "bytes": full_stack["bytes"],
+        "ms": ms,
+        "host_delivery_serial_ms": round(host_serial, 2),
+        "host_delivery_async_ms": round(host_async, 2),
+        "host_delivery_hidden_ms": round(host_async - exposed, 2),
+        "modeled_ms_per_frame": round(sum(ms.values()), 2),
+        "note": f"async delivery plane (this PR): depth-{PIPELINE_DEPTH} "
+                f"fetch pipeline + background delivery executor + "
+                f"{DELIVERY_WORKERS} per-tile encode workers — host "
+                f"work drops {round(host_serial, 2)} -> "
+                f"{round(host_async, 2)} ms ({DELIVERY_WORKERS}x codec "
+                f"fan-out) and overlaps the device frame, leaving "
+                f"{round(exposed, 2)} ms exposed: steady-state frame = "
+                f"max(device, host)",
+    })
+
     b0 = stack[0]["modeled_ms_per_frame"]
     for r_ in stack:
         r_["speedup_vs_baseline"] = round(b0 / r_["modeled_ms_per_frame"],
@@ -370,17 +456,29 @@ def main():
             "marches_per_frame": 1,
             "hbm_gbps": HBM_GBPS, "ici_gbps_effective": ICI_GBPS,
             "dcn_gbps_effective_per_host": DCN_GBPS,
+            "pcie_gbps": PCIE_GBPS,
+            "delivery_codec_gbps_per_worker": CODEC_GBPS,
+            "delivery_pipeline_depth": PIPELINE_DEPTH,
+            "delivery_encode_workers": DELIVERY_WORKERS,
+            "host_delivery_source":
+                "benchmarks/results/delivery_ab_r19_cpu.json (codec "
+                "throughput order; assumption: quantize+CRC sweeps the "
+                "input f32 bytes once at ~2 GB/s/worker, PCIe copy is "
+                "serial per host link)",
             "occupancy_march_reduction_source":
                 "benchmarks/results/occupancy_ab_r06_512.json (sim row)",
             "straggler_factor_source":
                 "benchmarks/results/rebalance_ab_r10_cpu.json (measured "
                 "CPU 96^3 skewed scene; assumption: the skew transfers "
                 "to 512^3 banded Gray-Scott, PR-6 live-cell 0.41)",
-            "excluded": "compute time, kernel launch/dispatch, host "
-                        "fetch, fold-state traffic beyond the composite "
+            "excluded": "compute time, kernel launch/dispatch, "
+                        "fold-state traffic beyond the composite "
                         "stream model — this is a TRAFFIC lower bound; "
                         "the flagship runs at ~8.4% of HBM peak, so "
-                        "read the RELATIVE deltas, not the absolute ms",
+                        "read the RELATIVE deltas, not the absolute ms "
+                        "(host delivery joined the model in PR 19: "
+                        "bytes x codec throughput + PCIe copy, "
+                        "overlapped per the +async_delivery row)",
             "note_sim_attribution": "the '~290 of 419 ms is sim' split "
                                     "(ROADMAP item 1) is still "
                                     "hardware-unconfirmed; this model "

@@ -190,6 +190,30 @@ def _x_divergence(doc: dict) -> Dict[str, Gate]:
     return out
 
 
+def _x_delivery(doc: dict) -> Dict[str, Gate]:
+    """The async delivery plane's A/B (ISSUE 19): the exposed-host
+    ratio is the tentpole number (async must keep the loop thread out
+    of the sink work — acceptance <= 0.5x serial, hence the floor);
+    the bitwise verdicts are the correctness contract and gate at
+    exactly 1."""
+    out = {}
+    if doc.get("value") is not None:
+        out["exposed_host_ratio"] = Gate(doc["value"], "lower", NOISY,
+                                         floor=0.5)
+    out["bit_identical"] = Gate(
+        1.0 if doc.get("bit_identical_all") else 0.0, "higher", 0.0,
+        floor=1.0)
+    out["ordering_fifo"] = Gate(
+        1.0 if doc.get("ordering_fifo_all") else 0.0, "higher", 0.0,
+        floor=1.0)
+    te = doc.get("tile_encode") or {}
+    if "byte_identical" in te:
+        out["tile_encode_byte_identical"] = Gate(
+            1.0 if te["byte_identical"] else 0.0, "higher", 0.0,
+            floor=1.0)
+    return out
+
+
 # (family name, matcher over the parsed doc, extractor)
 FAMILIES: Tuple[Tuple[str, object, object], ...] = (
     ("lod_ladder",
@@ -211,6 +235,9 @@ FAMILIES: Tuple[Tuple[str, object, object], ...] = (
      lambda d: isinstance(d.get("exchange"), dict), _x_waves),
     ("divergence_report",
      lambda d: d.get("type") == "divergence_report", _x_divergence),
+    ("delivery_ab",
+     lambda d: str(d.get("metric", "")).startswith("delivery_ab"),
+     _x_delivery),
 )
 
 

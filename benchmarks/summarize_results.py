@@ -236,6 +236,28 @@ def fmt(r: dict) -> str:
                 f"x{rung.get('flop_reduction')} flops  "
                 f"{rung.get('frame_ms')} ms  [{hist_s}]")
         return "\n   ".join(lines)
+    if str(r.get("metric", "")).startswith("delivery_ab"):
+        # async delivery plane A/B (watcher step 19)
+        lines = [f"{r['metric']}: exposed host x{r.get('value')} of "
+                 f"serial (bit_identical={r.get('bit_identical_all')}, "
+                 f"fifo={r.get('ordering_fifo_all')})"]
+        for name, a in (r.get("arms") or {}).items():
+            lag = (f"  lag p50/p99 {a.get('delivery_lag_p50_ms')}/"
+                   f"{a.get('delivery_lag_p99_ms')} ms"
+                   if a.get("delivery_lag_p50_ms") is not None else "")
+            lines.append(
+                f"  {name:9s} frame {a.get('frame_ms'):9.2f} ms  "
+                f"exposed {a.get('exposed_host_ms_per_frame'):7.2f} ms  "
+                f"offloaded {a.get('offloaded_host_ms_per_frame'):7.2f} "
+                f"ms{lag}")
+        te = r.get("tile_encode") or {}
+        if te:
+            par = te.get(f"ms_workers{te.get('workers')}")
+            lines.append(
+                f"  tile encode w1 {te.get('ms_workers1')} ms -> "
+                f"w{te.get('workers')} {par} ms "
+                f"(byte_identical={te.get('byte_identical')})")
+        return "\n   ".join(lines)
     if r.get("metric") == "serve_bench":          # edge-serving tier
         am = r.get("amortization", {})
         lines = [f"serve_bench: [{r.get('platform', '?')}] per-viewer "

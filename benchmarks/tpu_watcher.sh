@@ -203,6 +203,15 @@ run_step() {
            --out "$R/divergence_tpu_${ROUND}.json" 2>>"$L" \
            && echo "ok: $R/divergence_tpu_${ROUND}.json" >> "$L"
        fi ;;
+    # async delivery plane A/B on real devices (ISSUE 19; the committed
+    # CPU capture is delivery_ab_r19_cpu): serial vs async at pipeline
+    # depth 1/2/4 under a heavy compressing sink — exposed host ms,
+    # delivery lag percentiles, cross-arm bit-exactness, and the
+    # parallel per-tile encode byte-identity check
+    19) run_json "$R/delivery_ab_tpu_${ROUND}.json" 1200 env \
+         SITPU_DELIVERY_FRAMES=12 \
+         python benchmarks/delivery_bench.py \
+         --out "$R/delivery_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
@@ -226,10 +235,11 @@ step_out() {
     16) echo "$R/lod_ab_tpu_${ROUND}.json" ;;
     17) echo "$R/lod_2048_tpu_${ROUND}.json" ;;
     18) echo "$R/attribution_tpu_${ROUND}.json" ;;
+    19) echo "$R/delivery_ab_tpu_${ROUND}.json" ;;
   esac
 }
 
-NSTEPS=18
+NSTEPS=19
 STEPS=${SITPU_WATCHER_STEPS:-$(seq 1 $NSTEPS)}
 POLLS=${SITPU_WATCHER_POLLS:-900}
 SLEEP=${SITPU_WATCHER_SLEEP:-45}

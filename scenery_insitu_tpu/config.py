@@ -546,6 +546,19 @@ class RuntimeConfig:
     # Applies to volume-sim VDI sessions; other modes fall back to the
     # eager loop (runtime/session.py logs the downgrade).
     scan_frames: int = 0
+    # Device->host pipeline depth of the eager loop (docs/PERF.md "Async
+    # delivery"): how many dispatched frames may have their host copies
+    # in flight before the loop blocks on the oldest. 1 = the historical
+    # one-deep overlap (bitwise the pre-async behavior); deeper values
+    # only help when host delivery is slower than device compute AND the
+    # background delivery executor is absorbing the payloads — each
+    # extra slot pins roughly one more frame of host-copy memory.
+    pipeline_depth: int = 1
+
+    def __post_init__(self):
+        if self.pipeline_depth < 1:
+            raise ValueError(f"runtime.pipeline_depth must be >= 1, "
+                             f"got {self.pipeline_depth}")
 
 
 @dataclass(frozen=True)
@@ -621,6 +634,11 @@ class SLOConfig:
     # Per-phase budget, ms, applied to every recorded session phase
     # span (sim/dispatch/fetch/sinks...). 0 = no gate.
     phase_p99_ms: float = 0.0
+    # Delivery lag budget, ms: dispatch-to-delivered latency of a frame
+    # through the async delivery executor (runtime/delivery.py,
+    # docs/PERF.md "Async delivery") — how far behind the render loop
+    # the background sink tier is running. 0 = no gate.
+    delivery_lag_p99_ms: float = 0.0
 
     def __post_init__(self):
         if self.window < 8:
@@ -629,7 +647,8 @@ class SLOConfig:
             raise ValueError(f"need 1 <= min_samples <= window, got "
                              f"{self.min_samples} (window {self.window})")
         for k in ("frame_p99_ms", "staleness_p99_frames",
-                  "camera_to_pixel_p99_ms", "phase_p99_ms"):
+                  "camera_to_pixel_p99_ms", "phase_p99_ms",
+                  "delivery_lag_p99_ms"):
             if getattr(self, k) < 0:
                 raise ValueError(f"slo.{k} must be >= 0 (0 = no gate), "
                                  f"got {getattr(self, k)}")
@@ -722,6 +741,58 @@ class DeltaConfig:
         if self.range_tol < 0.0:
             raise ValueError(f"range_tol must be >= 0, "
                              f"got {self.range_tol}")
+
+
+@dataclass(frozen=True)
+class DeliveryConfig:
+    """Asynchronous delivery plane (runtime/delivery.py, docs/PERF.md
+    "Async delivery"): a background worker tier drains the per-frame
+    sink work off the render-loop thread, so steady-state frame time is
+    max(device, host) instead of device + host.
+
+    Disabled (the default) every sink runs inline on the loop thread —
+    bitwise the pre-async behavior. Enabled, the loop enqueues each
+    fetched frame's payloads onto a bounded FIFO and a worker thread
+    runs the sinks (tile sinks in ascending column order, then frame
+    sinks; frames strictly FIFO) behind the same SinkGuard quarantine.
+    ``overflow`` decides what a full queue costs: ``block`` (lossless —
+    the loop waits, correct for disk/checkpoint sinks) or
+    ``drop_oldest`` (latest-wins — the oldest undelivered frame is shed
+    with a ``delivery.shed`` ledger row + ``delivery_sheds`` counter,
+    correct for live streaming where a stale frame has no value)."""
+
+    # Run frame/tile sinks on the background executor instead of inline.
+    enabled: bool = False
+    # Bounded frame queue between the loop and the worker: at most this
+    # many undelivered frames in flight before ``overflow`` applies.
+    queue_frames: int = 4
+    # Full-queue policy: "block" (lossless backpressure) or
+    # "drop_oldest" (latest-wins shedding, ledgered).
+    overflow: str = "block"
+    # Per-tile encode fan-out (docs/PERF.md "Async delivery"): tile-sink
+    # calls for one frame run across this many threads with the results
+    # APPLIED in ascending tile order, so delivered bytes are
+    # bit-identical to the serial path. 1 = serial. Also consumed by
+    # VDIPublisher's parallel tile encoder.
+    encode_workers: int = 1
+    # Seconds ``drain()``/teardown waits for the queue to empty before
+    # ledgering the abandon (`delivery.drain`). Generous by default —
+    # a teardown must not lose committed frames.
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.queue_frames < 1:
+            raise ValueError(f"delivery.queue_frames must be >= 1, "
+                             f"got {self.queue_frames}")
+        if self.overflow not in ("block", "drop_oldest"):
+            raise ValueError(f"delivery.overflow must be 'block' or "
+                             f"'drop_oldest', got {self.overflow!r}")
+        if self.encode_workers < 1:
+            raise ValueError(f"delivery.encode_workers must be >= 1, "
+                             f"got {self.encode_workers}")
+        if self.drain_timeout_s <= 0:
+            raise ValueError(f"delivery.drain_timeout_s must be > 0, "
+                             f"got {self.drain_timeout_s}")
 
 
 @dataclass(frozen=True)
@@ -859,6 +930,7 @@ class FrameworkConfig:
     slo: SLOConfig = field(default_factory=SLOConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     delta: DeltaConfig = field(default_factory=DeltaConfig)
+    delivery: DeliveryConfig = field(default_factory=DeliveryConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     lod: LODConfig = field(default_factory=LODConfig)
 

@@ -135,8 +135,15 @@ def decompress(data: bytes, codec: str = "zstd") -> bytes:
 
 def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
              codec: str = "zstd", precision: str = "f32",
-             tile: Optional[Tuple[int, int, int]] = None) -> int:
+             tile: Optional[Tuple[int, int, int]] = None,
+             workers: int = 1) -> int:
     """Write a VDI (+ metadata) as one .npz artifact; returns bytes written.
+
+    ``workers > 1`` compresses the large members (color, depth) on a
+    thread pool — each member's blob is byte-identical to the serial
+    path (per-member compress calls are independent), only the wall
+    clock changes; used by the async delivery plane's disk sinks
+    (docs/PERF.md "Async delivery").
 
     The npz members are individually compressed with ``codec`` (numpy's own
     deflate is off) so load/save round-trips are bit-exact and fast.
@@ -191,12 +198,21 @@ def save_vdi(path: str, vdi: VDI, meta: Optional[VDIMetadata] = None,
             members[f"meta_{f}"] = np.asarray(getattr(meta, f))
     buf = io.BytesIO()
     packed = {}
+    big = [k for k, v in members.items()
+           if not k.startswith("__") and v.nbytes >= 1024]
+    if workers > 1 and len(big) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(workers,
+                                                len(big))) as pool:
+            blobs = dict(zip(big, pool.map(
+                lambda k: compress(members[k].tobytes(), codec), big)))
+    else:
+        blobs = {k: compress(members[k].tobytes(), codec) for k in big}
     for k, v in members.items():
-        if k.startswith("__") or v.nbytes < 1024:
+        if k not in blobs:
             packed[k] = v
         else:
-            blob = compress(v.tobytes(), codec)
-            packed[k] = np.frombuffer(blob, np.uint8)
+            packed[k] = np.frombuffer(blobs[k], np.uint8)
             packed[f"__shape__{k}"] = np.asarray(v.shape, np.int64)
             packed[f"__dtype__{k}"] = np.frombuffer(
                 str(v.dtype).encode(), np.uint8)

@@ -150,6 +150,17 @@ class ProfileCapture:
       the caller threads state between frames (bench.py); when omitted,
       frames are ``fn(*args)``.
 
+    ``host_time_fn`` (zero-arg, returns CUMULATIVE host seconds) lets
+    the caller attribute measured host-side work — e.g. the delivery
+    plane's encode/compress/sink time accumulated inside ``step`` — to
+    the ``host`` phase explicitly. Without it, CPU backends structurally
+    report ``host: 0``: the intra-op pool makes summed device-op time
+    exceed wall, the breakdown is normalized onto the WHOLE wall, and
+    host = wall - device vanishes. With the hook, device phases
+    normalize onto (wall - hooked host) instead, so the host-delivery
+    share survives normalization and the divergence engine can model it
+    (docs/OBSERVABILITY.md "Divergence engine").
+
     Disabled captures return None without touching the profiler, the
     trace machinery or the step — the zero-overhead path. Failures
     degrade through the ``obs.profiler`` ledger component and also
@@ -158,12 +169,14 @@ class ProfileCapture:
 
     def __init__(self, frames: int = 3, enabled: bool = True,
                  trace_dir: Optional[str] = None, warmup: int = 1,
-                 devices: Optional[int] = None):
+                 devices: Optional[int] = None,
+                 host_time_fn: Optional[Callable[[], float]] = None):
         self.frames = max(1, int(frames))
         self.enabled = bool(enabled)
         self.trace_dir = trace_dir
         self.warmup = max(0, int(warmup))
         self.devices = devices
+        self.host_time_fn = host_time_fn
 
     def capture(self, fn, *args,
                 step: Optional[Callable[[], Any]] = None
@@ -191,11 +204,17 @@ class ProfileCapture:
 
         trace_dir = self.trace_dir or tempfile.mkdtemp(
             prefix="sitpu_profile_")
+        h0 = self.host_time_fn() if self.host_time_fn else 0.0
         t0 = time.perf_counter()
         with jax.profiler.trace(trace_dir):
             for _ in range(self.frames):
                 jax.block_until_ready(run())
         wall_ms = (time.perf_counter() - t0) * 1e3 / self.frames
+        hook_ms = 0.0
+        if self.host_time_fn:
+            hook_ms = max(0.0, (self.host_time_fn() - h0) * 1e3
+                          / self.frames)
+            hook_ms = min(hook_ms, wall_ms)   # a hook cannot exceed wall
 
         phase_us: Dict[str, float] = {}
         phase_events: Dict[str, int] = {}
@@ -227,17 +246,21 @@ class ProfileCapture:
         # CPU runtimes execute ops across an intra-op thread pool, so
         # summed op time can exceed wall-clock (parallelism > 1); a TPU
         # core's timeline is serialized, so this is a no-op there. The
-        # breakdown is normalized onto the wall so the per-phase sum IS
-        # the frame time; op_parallelism keeps the raw ratio honest.
-        op_parallelism = (device_ms / wall_ms) if wall_ms > 0 else None
+        # breakdown is normalized onto the wall MINUS the hooked host
+        # time (measured host work is not the device's to claim) so the
+        # per-phase sum matches the measured step wall-clock by
+        # construction; op_parallelism keeps the raw ratio honest.
+        device_budget = max(0.0, wall_ms - hook_ms)
+        op_parallelism = (device_ms / device_budget
+                          if device_budget > 0 else None)
         normalized = False
         if op_parallelism is not None and op_parallelism > 1.0:
-            scale = wall_ms / device_ms
+            scale = device_budget / device_ms
             for p in phases.values():
                 p["ms"] = round(p["ms"] * scale, 4)
             device_ms = sum(p["ms"] for p in phases.values())
             normalized = True
-        host_ms = max(0.0, wall_ms - device_ms)
+        host_ms = hook_ms + max(0.0, wall_ms - hook_ms - device_ms)
         phases["host"] = {"ms": round(host_ms, 4), "events": 0}
 
         attr = {
@@ -249,6 +272,7 @@ class ProfileCapture:
             "devices": devices,
             "wall_ms_per_frame": round(wall_ms, 4),
             "device_ms_per_frame": round(device_ms, 4),
+            "host_hook_ms_per_frame": round(hook_ms, 4),
             "coverage": (round(min(1.0, op_parallelism), 4)
                          if op_parallelism is not None else None),
             "op_parallelism": (round(op_parallelism, 4)

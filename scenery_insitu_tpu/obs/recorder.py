@@ -119,6 +119,15 @@ _LEDGER_REGISTRY: Dict[str, str] = {
                    "VDI fragment can be carried (gather/hybrid/plain/"
                    "particle modes, scan blocks); every frame "
                    "re-marches",
+    "delivery.drain": "teardown drain of the async delivery queue timed "
+                      "out; undelivered frames were abandoned so "
+                      "shutdown could proceed",
+    "delivery.encode": "parallel per-tile encode requested together "
+                       "with temporal delta (stateful per-tile "
+                       "history); the publisher encodes serially",
+    "delivery.shed": "the bounded async delivery queue overflowed under "
+                     "overflow='drop_oldest'; the stalest undelivered "
+                     "frame was shed latest-wins",
     "divergence.modeled": "bench profiling: the model-vs-measured "
                           "divergence report could not be produced "
                           "(modeled projection missing or unreadable); "
@@ -279,6 +288,16 @@ _COUNTER_REGISTRY: Dict[str, str] = {
                       "hierarchical composite",
     "dcn_hops_built": "one DCN ring hop of the hierarchical exchange "
                       "was built",
+    "delivery_frames_delivered": "the async delivery worker finished "
+                                 "one frame's sinks (tiles in column "
+                                 "order, then the frame sinks)",
+    "delivery_frames_enqueued": "the render loop handed one fetched "
+                                "frame to the async delivery queue",
+    "delivery_frames_inflight": "net frames inside the delivery plane "
+                                "(+1 on enqueue, -1 on delivered or "
+                                "shed) — a gauge expressed as a counter",
+    "delivery_sheds": "the bounded delivery queue dropped its oldest "
+                      "undelivered frame (overflow='drop_oldest')",
     "delta_bytes_saved": "wire bytes avoided by a temporal-delta "
                          "(SKIP/P) record vs the full I-tile encoding",
     "delta_march_skipped": "a rank's re-march was skipped because its "
@@ -463,8 +482,20 @@ class Recorder:
         self.events: List[dict] = []
         self.counters: Dict[str, float] = {}
         self.max_events = max_events
-        self._stack: List[str] = []
+        # spans now open/close on the delivery worker threads too
+        # (runtime/delivery.py): the open-span stack is per-thread so a
+        # worker span cannot corrupt the loop thread's nesting, and the
+        # counter read-modify-write is locked
+        self._tls = threading.local()
+        self._lock = threading.Lock()
         self._dropped = 0
+
+    @property
+    def _stack(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
 
     @classmethod
     def from_config(cls, obs_cfg, rank: int = 0, log=None,
@@ -488,11 +519,12 @@ class Recorder:
         frames, ...). O(1) dict update — cheap enough to leave in hot
         paths unconditionally; the counter event stream is only recorded
         when enabled."""
-        self.counters[name] = self.counters.get(name, 0) + n
+        with self._lock:
+            value = self.counters[name] = self.counters.get(name, 0) + n
         if self.enabled:
             self._push({"type": "counter", "name": name, "rank": self.rank,
                         "ts": time.perf_counter() - self.epoch,
-                        "value": self.counters[name]})
+                        "value": value})
 
     def event(self, name: str, frame: Optional[int] = None,
               **attrs) -> None:

@@ -71,6 +71,7 @@ class SLOEngine:
         "frame_ms": "frame_p99_ms",
         "staleness_frames": "staleness_p99_frames",
         "camera_to_pixel_ms": "camera_to_pixel_p99_ms",
+        "delivery_lag_ms": "delivery_lag_p99_ms",
     }
 
     def __init__(self, cfg, recorder: Optional[_rec.Recorder] = None):

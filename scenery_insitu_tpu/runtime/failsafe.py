@@ -16,6 +16,7 @@ the same guard for its sinks.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Iterable
 
 from scenery_insitu_tpu import obs as _obs
@@ -47,29 +48,64 @@ class SinkGuard:
         self._failures = {}        # id(fn) -> (count, fn)
         self._quarantined = {}     # id(fn) -> fn
         self.quarantined_names = []
+        # the guard is shared between the render loop and the delivery
+        # executor's worker threads (runtime/delivery.py), so the
+        # count/quarantine bookkeeping must be atomic — the guarded
+        # callables themselves run OUTSIDE the lock (a slow sink must
+        # not serialize the other workers)
+        self._lock = threading.Lock()
 
     def is_quarantined(self, fn: Callable) -> bool:
-        return id(fn) in self._quarantined
+        with self._lock:
+            return id(fn) in self._quarantined
+
+    def reset(self, fn: Callable) -> bool:
+        """Lift ``fn``'s quarantine and clear its failure count (an
+        operator fixed the sink mid-run — re-admit it). Returns True
+        when the callable was actually quarantined. Ledgered so the
+        re-admission is as visible as the quarantine was."""
+        key = id(fn)
+        with self._lock:
+            was = self._quarantined.pop(key, None) is not None
+            self._failures.pop(key, None)
+            if was:
+                name = _name_of(fn)
+                if name in self.quarantined_names:
+                    self.quarantined_names.remove(name)
+        if was:
+            _obs.degrade(
+                "session.sink", f"quarantined {_name_of(fn)}",
+                "re-admitted",
+                "quarantine reset by the operator; failure count "
+                "cleared", warn=False)
+        return was
 
     def call(self, fn: Callable, *args, kind: str = "sink") -> bool:
         """Run ``fn(*args)`` inside the guard; returns True on success,
-        False when it failed or is quarantined. Never raises."""
+        False when it failed or is quarantined. Never raises.
+        Thread-safe: callable from delivery worker threads."""
         key = id(fn)
-        if key in self._quarantined:
-            return False
+        with self._lock:
+            if key in self._quarantined:
+                return False
         try:
             fn(*args)
         except Exception as e:
-            n = self._failures.get(key, (0, fn))[0] + 1
-            self._failures[key] = (n, fn)
             rec = _obs.get_recorder()
             rec.count("sink_failures")
             name = _name_of(fn)
+            with self._lock:
+                n = self._failures.get(key, (0, fn))[0] + 1
+                self._failures[key] = (n, fn)
+                quarantine = n >= self.max_failures
+                if quarantine and key not in self._quarantined:
+                    self._quarantined[key] = fn
+                    self.quarantined_names.append(name)
+                else:
+                    quarantine = False
             self.log(f"{kind} {name!r} failed "
                      f"({n}/{self.max_failures}): {e!r}")
-            if n >= self.max_failures:
-                self._quarantined[key] = fn
-                self.quarantined_names.append(name)
+            if quarantine:
                 rec.count("sinks_quarantined")
                 _obs.degrade(
                     "session.sink", f"{kind} {name}", "quarantined",
@@ -77,7 +113,8 @@ class SinkGuard:
                     f"{self.domain}; disabled for the rest of the run",
                     warn=False)
             return False
-        self._failures.pop(key, None)   # consecutive failures only
+        with self._lock:
+            self._failures.pop(key, None)   # consecutive failures only
         return True
 
     def run(self, fns: Iterable[Callable], *args,

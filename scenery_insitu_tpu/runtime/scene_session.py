@@ -58,6 +58,17 @@ class SceneSession:
         # on_steer run behind the guard; see drain_steering)
         self._sink_guard = SinkGuard(self.cfg.fault.max_sink_failures,
                                      log=self.log)
+        # same asynchronous delivery plane as InSituSession (docs/PERF.md
+        # "Async delivery"): delivery.enabled runs the frame sinks on a
+        # background worker; close() drains (SceneSession has no tile
+        # path, so jobs carry no tile payloads)
+        self._delivery = None
+        if self.cfg.delivery.enabled:
+            from scenery_insitu_tpu.runtime.delivery import (
+                DeliveryExecutor)
+            self._delivery = DeliveryExecutor(
+                self.cfg.delivery, self._sink_guard, [], self.sinks,
+                recorder=self.obs, slo=self.slo, log=self.log)
         self.frame_index = 0
         self.orbit_rate = 0.0
         self.steering = None
@@ -150,8 +161,12 @@ class SceneSession:
             else:
                 payload = {"image": np.asarray(out)}
             payload["frame"] = self.frame_index
-        with self.obs.span("sinks", frame=self.frame_index):
-            self._sink_guard.run(self.sinks, self.frame_index, payload)
+        if self._delivery is not None:
+            self._delivery.submit(self.frame_index, payload)
+        else:
+            with self.obs.span("sinks", frame=self.frame_index):
+                self._sink_guard.run(self.sinks, self.frame_index,
+                                     payload)
         advance_camera_and_index(self)
         self.timers.frame_done()
         self.slo.observe("frame_ms", (_time.perf_counter() - t_f) * 1e3,
@@ -164,8 +179,11 @@ class SceneSession:
         return payload
 
     def close(self) -> None:
-        """End-of-campaign teardown: flush the final partial timer
-        window + totals and write the obs sinks."""
+        """End-of-campaign teardown: drain the async delivery queue,
+        flush the final partial timer window + totals and write the obs
+        sinks."""
+        if self._delivery is not None:
+            self._delivery.drain()
         self.timers.dump_totals()
         self.obs.flush()
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import time
+from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -351,6 +352,21 @@ class InSituSession:
         # streaming.stream_tile_sink) start decoding the first columns
         # while later tiles are still being fetched
         self.tile_sinks: List[Sink] = []
+        # the asynchronous delivery plane (docs/PERF.md "Async
+        # delivery"): delivery.enabled moves the post-fetch sink work
+        # (tile payloads in column order, then the frame sinks) onto a
+        # background worker draining a bounded FIFO, so steady-state
+        # frame time is max(device, host) instead of device + host. The
+        # executor shares the SinkGuard and the LIVE sink lists above;
+        # run()/teardown drain it so no fetched frame is lost.
+        self._delivery = None
+        if self.cfg.delivery.enabled:
+            from scenery_insitu_tpu.runtime.delivery import (
+                DeliveryExecutor)
+            self._delivery = DeliveryExecutor(
+                self.cfg.delivery, self._sink_guard, self.tile_sinks,
+                self.sinks, recorder=self.obs, slo=self.slo,
+                log=self.log)
         self.frame_index = 0
         # render rebalancing (docs/PERF.md "Render rebalancing"): the
         # current planned z-band depths per rank (None = even split) and
@@ -598,11 +614,13 @@ class InSituSession:
         # metadata snapshot BEFORE the camera advances (fetch is pipelined
         # one frame behind, so it must not see the next frame's pose)
         self._pending_meta[self.frame_index] = meta
-        # bound the dict: the fetch runs at most one frame behind, so any
-        # older entry is unreachable — without this, a headless
-        # run(fetch=False) loop (which never pops) grows it forever
+        # bound the dict: the fetch runs at most pipeline_depth frames
+        # behind, so any older entry is unreachable — without this, a
+        # headless run(fetch=False) loop (which never pops) grows it
+        # forever
         for k in [k for k in self._pending_meta
-                  if k < self.frame_index - 1]:
+                  if k < self.frame_index
+                  - self.cfg.runtime.pipeline_depth]:
             del self._pending_meta[k]
         self.obs.count("frames_eager_dispatch")
         advance_camera_and_index(self)
@@ -637,16 +655,40 @@ class InSituSession:
 
         ctx = (jax.profiler.trace(profile_dir) if profile_dir
                else contextlib.nullcontext())
+        depth = self.cfg.runtime.pipeline_depth
         try:
             with ctx:
-                pending = None
+                # depth-k device->host pipeline (docs/PERF.md "Async
+                # delivery"): the deque holds the in-flight device
+                # frames, newest last; a frame retires (fetch + sink
+                # delivery, device refs dropped) once `depth` newer
+                # dispatches are in flight. depth 1 is bitwise the
+                # historical one-deep overlap.
+                pending = deque()
                 payload = {}
+                last = frames - 1
                 for i in range(frames):
                     t_f = time.perf_counter()
                     out = self.render_frame()
-                    if pending is not None and fetch:
-                        payload = self._fetch(*pending)
-                    pending = (self.frame_index - 1, out)
+                    if fetch:
+                        # start the device->host copy at dispatch time,
+                        # but only when somebody consumes it (sinks
+                        # registered, or the caller-visible payload of
+                        # the final frame) — a sink-less run pays no
+                        # host transfer at all
+                        consume = bool(self.sinks or self.tile_sinks) \
+                            or i == last
+                        if consume:
+                            self._start_host_copy(out)
+                        pending.append(
+                            (self.frame_index - 1, out, consume))
+                    else:
+                        pending.append(
+                            (self.frame_index - 1, out, False))
+                    out = None      # the deque holds the only device ref
+                    while len(pending) > depth:
+                        payload = self._retire(pending.popleft(),
+                                               fetch, payload)
                     self.timers.frame_done()
                     self.slo.observe(
                         "frame_ms",
@@ -654,42 +696,99 @@ class InSituSession:
                         frame=self.frame_index - 1)
                     if self._obs_pub is not None:
                         self._obs_pub.pump(self.obs)
-                if pending is not None and fetch:
-                    payload = self._fetch(*pending)
+                while pending:
+                    payload = self._retire(pending.popleft(), fetch,
+                                           payload)
         except BaseException:
             # flight recorder: an unhandled exception must not lose the
-            # final unflushed obs window — dump it, then keep raising
+            # final unflushed obs window — drain the delivery queue
+            # first (frames the device already paid for), dump, then
+            # keep raising
+            if self._delivery is not None:
+                self._delivery.drain()
             _obs.flight_flush(self.obs, where="run")
             if self._obs_pub is not None:
                 self._obs_pub.pump(self.obs, force=True)
             raise
-        # end-of-run teardown: the final partial window frame_done never
-        # reached, the whole-run totals, and the obs sinks
+        # end-of-run teardown: drain the async delivery queue, the final
+        # partial window frame_done never reached, the whole-run totals,
+        # and the obs sinks
+        if self._delivery is not None:
+            self._delivery.drain()
         self.timers.dump_totals()
         self.obs.flush()
         if self._obs_pub is not None:
             self._obs_pub.pump(self.obs, force=True)
         return payload
 
+    def _retire(self, entry, fetch: bool, payload: dict) -> dict:
+        """Retire one pipelined frame: fetch + deliver it when it has
+        consumers, otherwise just pace the loop on its device
+        completion. The caller already dropped the deque reference, so
+        the frame's device buffers free as soon as this returns — the
+        pipeline pins exactly `pipeline_depth` frames of HBM, never
+        more."""
+        index, out, consume = entry
+        if fetch and consume:
+            return self._fetch(index, out)
+        if fetch:
+            self._sync_nofetch(index, out)
+        return payload
+
+    def _start_host_copy(self, out) -> None:
+        """Kick off the device->host transfer of every buffer in ``out``
+        without blocking (``copy_to_host_async``): by the time the
+        depth-k pipeline retires this frame, the bytes are already on
+        the host and ``np.asarray`` is a cheap wrap, not a sync.
+        Best-effort — a backend without the method just pays the sync in
+        ``_fetch`` like before."""
+        try:
+            for leaf in jax.tree_util.tree_leaves(out):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+        except Exception:
+            pass
+
+    def _sync_nofetch(self, index: int, out) -> None:
+        """Retire a pipelined frame nobody consumes: drop its metadata
+        snapshot and pace on device completion WITHOUT the device->host
+        copy the historical path paid here (``fetch=True`` with no
+        sinks used to ``np.asarray`` every frame just to throw the
+        bytes away)."""
+        self._pending_meta.pop(index, None)
+        with self.obs.span("fetch", frame=index, host_copy=False):
+            jax.block_until_ready(out)
+
     def _fetch(self, index: int, out) -> dict:
         from scenery_insitu_tpu.ops.splat import SplatOutput
         meta = self._pending_meta.pop(index, None)
         if meta is None:
             meta = self.frame_metadata(index)
+        tiles = ()
+        tiled = bool(self.tile_sinks) \
+            and self.cfg.composite.schedule == "waves"
         with self.obs.span("fetch", frame=index):
             if isinstance(out, VDI):
                 # ONE device->host transfer; the tile delivery below and
-                # the frame payload share these buffers
+                # the frame payload share these buffers (a no-op wrap
+                # when _start_host_copy already landed the bytes)
                 color = np.asarray(out.color)
                 depth = np.asarray(out.depth)
-                if self.tile_sinks \
-                        and self.cfg.composite.schedule == "waves":
-                    # tile-granular path: each finished column block is
-                    # delivered BEFORE the frame payload is assembled —
-                    # the frame "closes" (frame sinks run) only after
-                    # every tile is already out the door
-                    self._deliver_tiles(index, None, meta,
-                                        color=color, depth=depth)
+                if tiled:
+                    if self._delivery is not None:
+                        # async path: slice the tile payloads (views,
+                        # no copy) here; the worker delivers them in
+                        # the same ascending column order
+                        tiles = self._tile_payloads(index, meta,
+                                                    color, depth)
+                    else:
+                        # tile-granular path: each finished column
+                        # block is delivered BEFORE the frame payload
+                        # is assembled — the frame "closes" (frame
+                        # sinks run) only after every tile is already
+                        # out the door
+                        self._deliver_tiles(index, None, meta,
+                                            color=color, depth=depth)
                 payload = {"vdi_color": color, "vdi_depth": depth}
             elif isinstance(out, SplatOutput):
                 payload = {"image": np.asarray(out.image),
@@ -698,38 +797,50 @@ class InSituSession:
                 payload = {"image": np.asarray(out)}
             payload["frame"] = index
             payload["meta"] = meta
-        with self.obs.span("sinks", frame=index):
-            self._sink_guard.run(self.sinks, index, payload)
+        if self._delivery is not None:
+            # off the critical path: the worker runs the tile sinks then
+            # the frame sinks behind the shared SinkGuard; the loop only
+            # pays the enqueue (or backpressure, per overflow policy)
+            self._delivery.submit(index, payload, tiles)
+        else:
+            with self.obs.span("sinks", frame=index):
+                self._sink_guard.run(self.sinks, index, payload)
         return payload
 
-    def _deliver_tiles(self, index: int, out, meta=None,
-                       color=None, depth=None) -> None:
-        """Hand every column-block tile of one composited VDI frame to
-        the tile sinks, in ascending global column order (the delivery
-        contract: tile t covers columns [t*wb, (t+1)*wb) and arrives
-        before tile t+1 and before the frame's own sinks). Tiles are the
-        wave schedule's unit — n_ranks * wave_tiles blocks; a width the
-        tiling does not divide degrades to per-rank blocks."""
-        if meta is None:
-            meta = self._pending_meta.get(index,
-                                          self.frame_metadata(index))
-        if color is None:
-            color = np.asarray(out.color)
-            depth = np.asarray(out.depth)
+    def _tile_payloads(self, index: int, meta, color, depth) -> list:
+        """Slice one composited VDI frame into its column-block tile
+        payloads, ascending global column order (tile t covers columns
+        [t*wb, (t+1)*wb)). Tiles are the wave schedule's unit — n_ranks
+        * wave_tiles blocks; a width the tiling does not divide degrades
+        to per-rank blocks. Slices are views: no host copy here."""
         n = self._n_ranks
         tiles = n * self.cfg.composite.wave_tiles
         w_total = color.shape[-1]
         if w_total % tiles:
             tiles = n                       # waves degraded to frame
         wb = w_total // tiles
-        for t in range(tiles):
-            with self.obs.span("tile", frame=index, tile=t):
-                payload = {
-                    "vdi_color": color[..., t * wb:(t + 1) * wb],
-                    "vdi_depth": depth[..., t * wb:(t + 1) * wb],
-                    "frame": index, "tile": t, "tiles": tiles,
-                    "col0": t * wb, "meta": meta,
-                }
+        return [{
+            "vdi_color": color[..., t * wb:(t + 1) * wb],
+            "vdi_depth": depth[..., t * wb:(t + 1) * wb],
+            "frame": index, "tile": t, "tiles": tiles,
+            "col0": t * wb, "meta": meta,
+        } for t in range(tiles)]
+
+    def _deliver_tiles(self, index: int, out, meta=None,
+                       color=None, depth=None) -> None:
+        """Hand every column-block tile of one composited VDI frame to
+        the tile sinks, in ascending global column order (the delivery
+        contract: tile t arrives before tile t+1 and before the frame's
+        own sinks)."""
+        if meta is None:
+            meta = self._pending_meta.get(index,
+                                          self.frame_metadata(index))
+        if color is None:
+            color = np.asarray(out.color)
+            depth = np.asarray(out.depth)
+        for payload in self._tile_payloads(index, meta, color, depth):
+            with self.obs.span("tile", frame=index,
+                               tile=payload["tile"]):
                 self.obs.count("tiles_delivered")
                 self._sink_guard.run(self.tile_sinks, index, payload,
                                      kind="tile sink")
@@ -1088,11 +1199,16 @@ class InSituSession:
             with ctx:
                 payload = self._scan_loop(frames, fetch, payload)
         except BaseException:
-            # flight recorder (same contract as the eager loop)
+            # flight recorder (same contract as the eager loop): drain
+            # the delivery queue first, then dump
+            if self._delivery is not None:
+                self._delivery.drain()
             _obs.flight_flush(self.obs, where="run_scan")
             if self._obs_pub is not None:
                 self._obs_pub.pump(self.obs, force=True)
             raise
+        if self._delivery is not None:
+            self._delivery.drain()
         self.timers.dump_totals()
         self.obs.flush()
         if self._obs_pub is not None:
@@ -1196,17 +1312,24 @@ class InSituSession:
                         meta = meta._replace(index=jnp.int32(idx))
                     else:
                         meta = self.frame_metadata(idx, camera=cams[i])
-                    if self.tile_sinks \
-                            and self.cfg.composite.schedule == "waves":
-                        self._deliver_tiles(idx, None, meta,
-                                            color=color[i],
-                                            depth=depth[i])
+                    tiled = bool(self.tile_sinks) \
+                        and self.cfg.composite.schedule == "waves"
                     payload = {"vdi_color": color[i],
                                "vdi_depth": depth[i],
                                "frame": idx, "meta": meta}
-                    with self.obs.span("sinks", frame=idx):
-                        self._sink_guard.run(self.sinks, idx,
-                                             payload)
+                    if self._delivery is not None:
+                        tiles = (self._tile_payloads(
+                            idx, meta, color[i], depth[i])
+                            if tiled else ())
+                        self._delivery.submit(idx, payload, tiles)
+                    else:
+                        if tiled:
+                            self._deliver_tiles(idx, None, meta,
+                                                color=color[i],
+                                                depth=depth[i])
+                        with self.obs.span("sinks", frame=idx):
+                            self._sink_guard.run(self.sinks, idx,
+                                                 payload)
                     self.timers.frame_done()
             else:
                 for _ in range(block):
@@ -1547,10 +1670,14 @@ class InSituSession:
 
 
 def vdi_sink(directory: str, dataset: str = "session", every: int = 1,
-             codec: str = "zstd") -> Sink:
+             codec: str = "zstd", workers: int = 1) -> Sink:
     """Dump composited VDIs as .npz artifacts — the render-product
     checkpoint stream offline renderers replay (≅ saveFinal VDIDataIO +
-    buffer dumps, DistributedVolumes.kt:846-851, 910-915)."""
+    buffer dumps, DistributedVolumes.kt:846-851, 910-915).
+
+    ``workers`` threads io.vdi_io.save_vdi's per-member compression
+    (byte-identical artifacts, shorter sink time — wire it to
+    cfg.delivery.encode_workers on the async delivery plane)."""
     from scenery_insitu_tpu.core.vdi import VDI as _VDI
     from scenery_insitu_tpu.io.vdi_io import dump_path, save_vdi
 
@@ -1559,13 +1686,13 @@ def vdi_sink(directory: str, dataset: str = "session", every: int = 1,
             return
         save_vdi(dump_path(directory, dataset, index, "vdi"),
                  _VDI(payload["vdi_color"], payload["vdi_depth"]),
-                 codec=codec)
+                 codec=codec, workers=workers)
 
     return sink
 
 
 def vdi_tile_sink(directory: str, dataset: str = "session", every: int = 1,
-                  codec: str = "zstd") -> Sink:
+                  codec: str = "zstd", workers: int = 1) -> Sink:
     """Tile-granular twin of `vdi_sink` for ``InSituSession.tile_sinks``
     (composite.schedule == "waves"): each finished column-block tile is
     dumped as its own .npz the moment it is delivered — an offline
@@ -1585,7 +1712,7 @@ def vdi_tile_sink(directory: str, dataset: str = "session", every: int = 1,
                  _VDI(payload["vdi_color"], payload["vdi_depth"]),
                  payload.get("meta"), codec=codec,
                  tile=(payload["tile"], payload["tiles"],
-                       payload["col0"]))
+                       payload["col0"]), workers=workers)
 
     return sink
 

@@ -41,6 +41,7 @@ import os
 import threading
 import time
 import zlib
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Iterator, Optional, Tuple, Union
 
@@ -203,12 +204,16 @@ class VDIPublisher(_HeartbeatPacer):
                  level: int = -1, precision: str = "f32",
                  fault: Optional[FaultConfig] = None,
                  epoch: Optional[int] = None,
-                 delta: Optional[DeltaConfig] = None):
+                 delta: Optional[DeltaConfig] = None,
+                 encode_workers: int = 1):
         from scenery_insitu_tpu.io.vdi_io import resolve_codec
 
         if precision not in ("f32", "qpack8"):
             raise ValueError(f"precision must be 'f32' or 'qpack8', "
                              f"got {precision!r}")
+        if encode_workers < 1:
+            raise ValueError(f"encode_workers must be >= 1, "
+                             f"got {encode_workers}")
         # temporal-delta wire codec (docs/PERF.md "Temporal deltas"):
         # per-tile SKIP / residual / I-tile records against the retained
         # previous frame. Code-space comparison is only exact on the
@@ -222,6 +227,27 @@ class VDIPublisher(_HeartbeatPacer):
             from scenery_insitu_tpu.ops.delta import DeltaEncoder
 
             self._delta = DeltaEncoder(delta.iframe_period)
+        # parallel tile encode (docs/PERF.md "Async delivery"): the
+        # column-block tile is the independent unit, so the per-tile
+        # quantize/compress/CRC work of publish_tile fans out across a
+        # small thread pool; wire messages still post in submission
+        # (ascending column) order, so delivered bytes are bit-identical
+        # to the serial path. The temporal-delta codec is stateful per
+        # tile key (encode order IS the codec state), so delta forces
+        # the serial path — ledgered, not silent.
+        self.encode_workers = int(encode_workers)
+        if self.encode_workers > 1 and self._delta is not None:
+            from scenery_insitu_tpu import obs as _obs
+            _obs.degrade("delivery.encode",
+                         f"{self.encode_workers} encode workers",
+                         "serial",
+                         "temporal delta is stateful per tile (P-frame "
+                         "records compare against the retained previous "
+                         "tile), so parallel encode would race the "
+                         "codec state", warn=False)
+            self.encode_workers = 1
+        self._pool = None
+        self._enc_pending = deque()   # futures in tile submission order
         zmq = _zmq()
         # degrade the default codec when the optional zstandard package
         # is absent (the resolved name travels in every frame header, so
@@ -289,7 +315,10 @@ class VDIPublisher(_HeartbeatPacer):
 
     def publish(self, vdi: VDI, meta: VDIMetadata) -> int:
         """Send one frame; returns wire bytes (≅ the compressed publish loop,
-        VolumeFromFileExample.kt:974-1037)."""
+        VolumeFromFileExample.kt:974-1037). Any tile encodes still in
+        flight post first — the frame message closes the frame AFTER its
+        tiles, whatever the pool's timing."""
+        self.flush_tiles()
         return self._send(vdi, meta, None)
 
     def publish_tile(self, vdi: VDI, meta: VDIMetadata, tile: int,
@@ -300,15 +329,48 @@ class VDIPublisher(_HeartbeatPacer):
         multipart message is the frame format plus a ``tile`` header
         {tile, tiles, col0}; `VDISubscriber.receive_tile` returns the
         placement so a viewer can assemble the frame incrementally (or
-        start a partial novel-view render on the columns it has)."""
-        return self._send(vdi, meta,
-                          {"tile": int(tile), "tiles": int(tiles),
-                           "col0": int(col0)})
+        start a partial novel-view render on the columns it has).
+
+        With ``encode_workers > 1`` the encode runs on the pool and the
+        wire post is deferred (messages still go out in submission
+        order; ``flush_tiles``/``publish`` forces them out) — the call
+        then returns 0 and the flush accounts the bytes."""
+        th = {"tile": int(tile), "tiles": int(tiles), "col0": int(col0)}
+        if self.encode_workers > 1:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.encode_workers,
+                    thread_name_prefix="vdi-encode")
+            self._enc_pending.append(
+                self._pool.submit(self._encode, vdi, meta, th))
+            # bound the in-flight window: post (in order) anything the
+            # pool already finished, and never hold more than 2x the
+            # pool width of undelivered encodes
+            while self._enc_pending and (
+                    self._enc_pending[0].done()
+                    or len(self._enc_pending) > 2 * self.encode_workers):
+                self._post(*self._enc_pending.popleft().result())
+            return 0
+        return self._send(vdi, meta, th)
+
+    def flush_tiles(self) -> int:
+        """Post every deferred tile encode, in submission order; returns
+        the wire bytes flushed. No-op on the serial path."""
+        total = 0
+        while self._enc_pending:
+            total += self._post(*self._enc_pending.popleft().result())
+        return total
 
     def _send(self, vdi: VDI, meta: VDIMetadata,
               tile: Optional[dict]) -> int:
-        from scenery_insitu_tpu import obs as _obs
+        return self._post(*self._encode(vdi, meta, tile))
 
+    def _encode(self, vdi: VDI, meta: VDIMetadata,
+                tile: Optional[dict]):
+        """Deterministic encode half (quantize, delta, compress, CRC,
+        header fields sans seq) — pure per tile, safe on pool threads.
+        The seq-dependent wire post lives in ``_post``."""
         fidx = int(np.asarray(meta.index))
         with _obs.get_recorder().span(
                 "encode", frame=fidx,
@@ -369,6 +431,13 @@ class VDIPublisher(_HeartbeatPacer):
                 # frame-bytes message; old decoders ignore unknown keys
                 "tc": trace_ctx(fidx, _obs.get_recorder().rank),
             }
+        return fields, cblob, dblob, fidx, tile
+
+    def _post(self, fields: dict, cblob: bytes, dblob: bytes,
+              fidx: int, tile: Optional[dict]) -> int:
+        """Wire half: mint the seq and send. Loop/worker thread only —
+        posts must happen in tile order (the seq is the subscriber's
+        continuity check), so this is never called from the pool."""
         lineage("tile" if tile else "publish", "send", fidx,
                 **({"tile": tile["tile"]} if tile else {}))
         with self._send_lock:
@@ -397,6 +466,13 @@ class VDIPublisher(_HeartbeatPacer):
         return None if self._delta is None else dict(self._delta.stats)
 
     def close(self) -> None:
+        try:
+            self.flush_tiles()     # deferred encodes must not be lost
+        except Exception:
+            pass
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
         if self._hb_stop is not None:
             self._hb_stop.set()
         if self._hb_thread is not None:

@@ -14,6 +14,7 @@ arbitrarily long campaigns.
 from __future__ import annotations
 
 import math
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -75,6 +76,10 @@ class Timers:
         self.window_stats: Dict[str, PhaseStats] = defaultdict(PhaseStats)
         self.frames = 0
         self._last_frame_t: Optional[float] = None
+        # recorder spans feed record() from the delivery worker threads
+        # too; the defaultdict first-touch and the PhaseStats
+        # read-modify-write must be atomic across threads
+        self._lock = threading.Lock()
 
     @contextmanager
     def phase(self, name: str):
@@ -82,13 +87,12 @@ class Timers:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.stats[name].add(dt)
-            self.window_stats[name].add(dt)
+            self.record(name, time.perf_counter() - t0)
 
     def record(self, name: str, seconds: float) -> None:
-        self.stats[name].add(seconds)
-        self.window_stats[name].add(seconds)
+        with self._lock:
+            self.stats[name].add(seconds)
+            self.window_stats[name].add(seconds)
 
     def marker(self, tag: str, iteration: int, seconds: float) -> None:
         """Machine-greppable marker (≅ #COMP:rank:iter:sec#)."""

@@ -560,6 +560,51 @@ def run_matrix(seed: int = 0, frames: int = 12) -> dict:
     scenario("serve/delta_resync_midjoin", ["stream.delta_resync"],
              serve_delta_midjoin)
 
+    # --- async delivery plane under a slow sink (ISSUE 19) --------------
+    def delivery_backpressure():
+        """A deliberately slow frame sink behind the bounded delivery
+        queue in ``drop_oldest`` mode: the submitting loop never blocks
+        on the sink, the stalest undelivered frames shed typed
+        (``delivery.shed`` ledger + ``delivery_sheds`` counter), the
+        survivors arrive strictly FIFO, and drain() leaves nothing in
+        flight."""
+        import threading
+
+        from scenery_insitu_tpu.config import DeliveryConfig
+        from scenery_insitu_tpu.runtime.delivery import DeliveryExecutor
+        from scenery_insitu_tpu.runtime.failsafe import SinkGuard
+
+        sink_s = 0.05
+        done, lock = [], threading.Lock()
+
+        def slow_sink(index, payload):
+            time.sleep(sink_s)
+            with lock:
+                done.append(index)
+
+        cfg = DeliveryConfig(enabled=True, queue_frames=2,
+                             overflow="drop_oldest")
+        ex = DeliveryExecutor(cfg, SinkGuard(), [], [slow_sink])
+        try:
+            t0 = time.monotonic()
+            for i in range(frames):
+                ex.submit(i, {"frame": i})
+            submit_s = time.monotonic() - t0
+            # the loop thread must never serialize on the slow sink
+            assert submit_s < 0.5 * frames * sink_s, submit_s
+            assert ex.drain(timeout_s=30.0)
+        finally:
+            ex.close()
+        with lock:
+            got = list(done)
+        assert got == sorted(got) and len(set(got)) == len(got)
+        assert ex.sheds > 0 and ex.delivered == len(got)
+        assert ex.delivered + ex.sheds == ex.enqueued
+        return {"submitted": frames, "delivered": ex.delivered,
+                "sheds": ex.sheds, "submit_s": round(submit_s, 4)}
+    scenario("delivery/slow_sink_backpressure", ["delivery.shed"],
+             delivery_backpressure)
+
     # --- telemetry collector dies mid-run (ISSUE 17) --------------------
     def collector_death():
         """The fleet-telemetry collector is killed halfway through the

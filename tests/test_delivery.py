@@ -1,0 +1,353 @@
+"""The asynchronous delivery plane (ISSUE 19; runtime/delivery.py,
+docs/PERF.md "Async delivery"): ordering contract, bitwise parity with
+the serial path, worker-thread quarantine + reset, shed/backpressure
+policies, teardown drains, HBM release, and the parallel per-tile
+encode byte-identity contracts."""
+
+import gc
+import threading
+import time
+import weakref
+
+import numpy as np
+import pytest
+
+from scenery_insitu_tpu import obs
+from scenery_insitu_tpu.config import DeliveryConfig, FrameworkConfig
+from scenery_insitu_tpu.parallel.mesh import make_mesh
+from scenery_insitu_tpu.runtime.delivery import DeliveryExecutor
+from scenery_insitu_tpu.runtime.failsafe import SinkGuard
+from scenery_insitu_tpu.runtime.session import InSituSession
+
+
+def _cfg(**kw):
+    cfg = FrameworkConfig().with_overrides(
+        "render.width=32", "render.height=24", "render.max_steps=24",
+        "vdi.max_supersegments=6", "vdi.adaptive_iters=2",
+        "composite.max_output_supersegments=8",
+        "composite.adaptive_iters=2", "sim.grid=[16,16,16]",
+        "sim.steps_per_frame=2", "runtime.stats_window=2")
+    return cfg.with_overrides(*[f"{k}={v}" for k, v in kw.items()])
+
+
+class _CaptureSink:
+    """Frame sink recording (frame, color bytes, thread name) — the
+    cross-run bitwise comparator."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.calls = []
+
+    def __call__(self, index, payload):
+        with self.lock:
+            self.calls.append(
+                (int(payload["frame"]),
+                 np.asarray(payload["vdi_color"]).tobytes(),
+                 threading.current_thread().name))
+
+
+# ---------------------------------------------------------------- parity
+
+def test_async_session_bitwise_matches_serial():
+    """delivery.enabled must change WHERE sinks run, never what they
+    see: same frame order, bit-identical payload bytes, and the
+    delivery counters account for every frame."""
+    runs = {}
+    for name, ovs in (("serial", {}),
+                      ("async", {"delivery.enabled": "true",
+                                 "runtime.pipeline_depth": "2"})):
+        sink = _CaptureSink()
+        sess = InSituSession(_cfg(**ovs), mesh=make_mesh(4),
+                             sinks=[sink])
+        sess.run(3)
+        runs[name] = (sink.calls, dict(sess.obs.counters))
+    serial, async_ = runs["serial"][0], runs["async"][0]
+    assert [c[0] for c in serial] == [c[0] for c in async_] == [0, 1, 2]
+    for (_, sb, _), (_, ab, _) in zip(serial, async_):
+        assert sb == ab
+    # serial ran inline on the loop thread, async on the worker
+    assert all(th != "delivery-worker" for _, _, th in serial)
+    assert all(th == "delivery-worker" for _, _, th in async_)
+    counters = runs["async"][1]
+    assert counters["delivery_frames_enqueued"] == 3
+    assert counters["delivery_frames_delivered"] == 3
+    assert counters["delivery_frames_inflight"] == 0
+    assert counters.get("delivery_sheds", 0) == 0
+
+
+def test_pipeline_depth_without_delivery_is_bitwise():
+    """pipeline_depth alone (async fetch, inline sinks) must be
+    bit-identical to the depth-1 default, frames in order."""
+    runs = []
+    for depth in (1, 3):
+        sink = _CaptureSink()
+        sess = InSituSession(
+            _cfg(**{"runtime.pipeline_depth": str(depth)}),
+            mesh=make_mesh(4), sinks=[sink])
+        sess.run(4)
+        runs.append(sink.calls)
+    assert [c[0] for c in runs[0]] == [c[0] for c in runs[1]]
+    for (_, b0, _), (_, b1, _) in zip(*runs):
+        assert b0 == b1
+
+
+# ------------------------------------------------------------- ordering
+
+def test_tile_ordering_contract_async():
+    """Ordering contract under async delivery: within a frame the tile
+    payloads arrive in ascending column order, THEN the frame sinks run
+    (the frame closes after its tiles); across frames strictly FIFO."""
+    events, lock = [], threading.Lock()
+
+    def tile_sink(index, payload):
+        with lock:
+            events.append(("tile", int(payload["frame"]),
+                           int(payload["tile"]), int(payload["col0"])))
+
+    def frame_sink(index, payload):
+        with lock:
+            events.append(("frame", int(payload["frame"]), None, None))
+
+    cfg = _cfg(**{"composite.schedule": "waves",
+                  "delivery.enabled": "true",
+                  "runtime.pipeline_depth": "2"})
+    sess = InSituSession(cfg, mesh=make_mesh(4), sinks=[frame_sink])
+    sess.tile_sinks.append(tile_sink)
+    sess.run(3)
+
+    frames_seen = []
+    last_tile = {}
+    for kind, f, t, col0 in events:
+        if kind == "tile":
+            assert f not in frames_seen, "tile after its frame closed"
+            if f in last_tile:
+                assert t == last_tile[f][0] + 1, "tiles out of order"
+                assert col0 > last_tile[f][1], "columns not ascending"
+            else:
+                assert t == 0
+            last_tile[f] = (t, col0)
+        else:
+            frames_seen.append(f)
+    assert frames_seen == [0, 1, 2]
+    assert set(last_tile) == {0, 1, 2}
+
+
+# --------------------------------------------- quarantine on the worker
+
+def test_worker_thread_quarantine_and_reset():
+    """SinkGuard shared with the delivery worker: a sink failing on the
+    worker thread quarantines after max_failures, the ledger records
+    it, reset() re-admits it and it runs again — all off the loop
+    thread."""
+    obs.clear_ledger()
+    bad_calls, good_calls = [], []
+
+    def bad(index, payload):
+        bad_calls.append(index)
+        raise ValueError("sink bug")
+
+    def good(index, payload):
+        good_calls.append(index)
+
+    guard = SinkGuard(max_failures=2)
+    ex = DeliveryExecutor(DeliveryConfig(enabled=True), guard, [],
+                          [bad, good])
+    try:
+        for i in range(4):
+            ex.submit(i, {"frame": i})
+        assert ex.drain(timeout_s=30.0)
+        # bad failed twice then quarantined; good never missed a frame
+        assert guard.is_quarantined(bad)
+        assert bad_calls == [0, 1]
+        assert good_calls == [0, 1, 2, 3]
+        assert any(e["component"] == "session.sink"
+                   and e["to"] == "quarantined" for e in obs.ledger())
+        # operator reset: re-admitted, runs again on the worker
+        assert guard.reset(bad)
+        assert not guard.is_quarantined(bad)
+        ex.submit(4, {"frame": 4})
+        assert ex.drain(timeout_s=30.0)
+        assert 4 in bad_calls
+        assert any(e["component"] == "session.sink"
+                   and e["to"] == "re-admitted" for e in obs.ledger())
+    finally:
+        ex.close()
+
+
+# ------------------------------------------------------- overflow policy
+
+def test_block_policy_is_lossless():
+    done = []
+
+    def slow(index, payload):
+        time.sleep(0.02)
+        done.append(index)
+
+    ex = DeliveryExecutor(
+        DeliveryConfig(enabled=True, queue_frames=2, overflow="block"),
+        SinkGuard(), [], [slow])
+    try:
+        for i in range(8):
+            assert ex.submit(i, {"frame": i})
+        assert ex.drain(timeout_s=30.0)
+    finally:
+        ex.close()
+    assert done == list(range(8))
+    assert ex.sheds == 0
+
+
+def test_drop_oldest_sheds_and_never_blocks():
+    obs.clear_ledger()
+    done = []
+
+    def slow(index, payload):
+        time.sleep(0.05)
+        done.append(index)
+
+    rec = obs.get_recorder()
+    base_sheds = rec.counters.get("delivery_sheds", 0)
+    ex = DeliveryExecutor(
+        DeliveryConfig(enabled=True, queue_frames=1,
+                       overflow="drop_oldest"),
+        SinkGuard(), [], [slow])
+    try:
+        t0 = time.monotonic()
+        results = [ex.submit(i, {"frame": i}) for i in range(10)]
+        # submissions return instantly — the loop never waits on the sink
+        assert time.monotonic() - t0 < 0.25
+        assert ex.drain(timeout_s=30.0)
+    finally:
+        ex.close()
+    assert ex.sheds > 0 and not all(results)
+    assert ex.delivered + ex.sheds == ex.enqueued == 10
+    # survivors strictly FIFO, no duplicates
+    assert done == sorted(done) and len(set(done)) == len(done)
+    assert rec.counters.get("delivery_sheds", 0) - base_sheds == ex.sheds
+    assert any(e["component"] == "delivery.shed" for e in obs.ledger())
+
+
+# ------------------------------------------------------------- teardown
+
+def test_drain_timeout_abandons_and_ledgers():
+    obs.clear_ledger()
+    release = threading.Event()
+
+    def wedged(index, payload):
+        release.wait(30.0)
+
+    ex = DeliveryExecutor(
+        DeliveryConfig(enabled=True, queue_frames=8),
+        SinkGuard(), [], [wedged])
+    try:
+        for i in range(3):
+            ex.submit(i, {"frame": i})
+        assert ex.drain(timeout_s=0.2) is False
+        assert any(e["component"] == "delivery.drain"
+                   for e in obs.ledger())
+    finally:
+        release.set()
+        ex.close(timeout_s=1.0)
+
+
+def test_crash_path_drains_delivery():
+    """An exception on the loop thread mid-run must still drain the
+    delivery queue (the flight-recorder teardown path): every frame the
+    device already paid for is delivered exactly once, no duplicates."""
+    sink = _CaptureSink()
+    sess = InSituSession(
+        _cfg(**{"delivery.enabled": "true",
+                "runtime.pipeline_depth": "2"}),
+        mesh=make_mesh(4), sinks=[sink])
+    calls = {"n": 0}
+    orig = sess.slo.observe
+
+    def bomb(name, *a, **kw):
+        # loop-thread observations only — the delivery worker shares
+        # this SLOEngine for delivery_lag_ms and must stay healthy
+        if name == "frame_ms":
+            calls["n"] += 1
+            if calls["n"] >= 3:
+                raise RuntimeError("mid-run failure")
+        return orig(name, *a, **kw)
+
+    sess.slo.observe = bomb
+    with pytest.raises(RuntimeError, match="mid-run failure"):
+        sess.run(6)
+    delivered = [c[0] for c in sink.calls]
+    # whatever was enqueued before the crash arrived, in order, once
+    assert delivered == sorted(delivered)
+    assert len(set(delivered)) == len(delivered)
+    assert len(delivered) >= 1
+    counters = sess.obs.counters
+    assert counters["delivery_frames_delivered"] == len(delivered)
+    assert counters["delivery_frames_inflight"] == 0
+
+
+# ------------------------------------------------------------ HBM release
+
+def test_device_buffers_released_after_retire():
+    """The depth-k pipeline must not pin device frames: once a frame is
+    retired (host copy landed, sinks fed) its device buffers die — the
+    pre-PR-19 eager loop kept an extra frame alive in its ``pending``
+    slot. Weakrefs on every retired entry's jax leaves must all clear
+    by the end of the run."""
+    import jax
+
+    refs = []
+    sess = InSituSession(
+        _cfg(**{"runtime.pipeline_depth": "2",
+                "delivery.enabled": "true"}),
+        mesh=make_mesh(8), sinks=[lambda i, p: None])
+    orig = sess._retire
+
+    def spy(entry, fetch, payload):
+        refs.extend(weakref.ref(leaf)
+                    for leaf in jax.tree_util.tree_leaves(entry[1])
+                    if isinstance(leaf, jax.Array))
+        return orig(entry, fetch, payload)
+
+    sess._retire = spy
+    payload = sess.run(4)
+    assert refs, "retire spy saw no device leaves"
+    del payload          # np views may pin the final frame's buffers
+    gc.collect()
+    alive = [r for r in refs if r() is not None]
+    assert not alive, f"{len(alive)}/{len(refs)} device leaves pinned"
+
+
+# ------------------------------------------- parallel per-tile encode
+
+def test_save_vdi_workers_byte_identical(tmp_path):
+    from scenery_insitu_tpu.core.vdi import VDI
+    from scenery_insitu_tpu.io.vdi_io import save_vdi
+
+    rng = np.random.default_rng(3)
+    vdi = VDI(rng.random((6, 4, 24, 32)).astype(np.float32),
+              np.sort(rng.random((6, 2, 24, 32)).astype(np.float32),
+                      axis=1))
+    paths = {}
+    for w in (1, 4):
+        p = str(tmp_path / f"w{w}.npz")
+        save_vdi(p, vdi, codec="zlib", workers=w)
+        paths[w] = open(p, "rb").read()
+    assert paths[1] == paths[4]
+
+
+def test_publisher_delta_forces_serial_encode():
+    """Parallel per-tile encode is stateless; the temporal-delta
+    encoder is stateful per tile — requesting both must degrade to
+    serial with a ``delivery.encode`` ledger row, not race."""
+    from scenery_insitu_tpu.config import DeltaConfig
+    from scenery_insitu_tpu.runtime.streaming import VDIPublisher
+
+    obs.clear_ledger()
+    pub = VDIPublisher("tcp://127.0.0.1:0", codec="zlib",
+                       precision="qpack8",
+                       delta=DeltaConfig(enabled=True),
+                       encode_workers=4)
+    try:
+        assert pub.encode_workers == 1
+        assert any(e["component"] == "delivery.encode"
+                   for e in obs.ledger())
+    finally:
+        pub.close()
